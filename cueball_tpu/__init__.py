@@ -1,0 +1,81 @@
+"""cueball_tpu -- connection pooling and service discovery for TPU-host fleets.
+
+A from-scratch, asyncio-native rebuild of the capability set of
+TritonDataCenter/node-cueball (reference: /root/reference/lib/index.js:17-38).
+Where the reference manages pools of TCP/TLS connections from Node.js
+services to DNS-discovered backends, this framework manages pools of
+asyncio connections from TPU-host processes (controllers, data loaders,
+inference routers) to DCN-side service fleets.
+
+Public API parity map (reference lib/index.js:17-38):
+
+  ConnectionPool        -> cueball_tpu.ConnectionPool      (pool.py)
+  ConnectionSet         -> cueball_tpu.ConnectionSet       (cset.py)
+  Resolver              -> cueball_tpu.Resolver            (resolver.py)
+  DNSResolver           -> cueball_tpu.DNSResolver         (resolver.py)
+  StaticIpResolver      -> cueball_tpu.StaticIpResolver    (resolver.py)
+  resolverForIpOrDomain -> cueball_tpu.resolver_for_ip_or_domain
+  HttpAgent/HttpsAgent  -> cueball_tpu.HttpAgent/HttpsAgent (agent.py)
+  poolMonitor           -> cueball_tpu.pool_monitor        (monitor.py)
+  enableStackTraces     -> cueball_tpu.enable_stack_traces (utils.py)
+  error classes         -> cueball_tpu.errors              (errors.py)
+
+The numeric control algorithms (low-pass shrink damping, CoDel, backoff
+schedules) additionally have batched JAX implementations under
+cueball_tpu.ops / cueball_tpu.parallel for fleet-scale telemetry on TPU.
+"""
+
+from .errors import (
+    ClaimHandleMisusedError,
+    ClaimTimeoutError,
+    NoBackendsError,
+    PoolFailedError,
+    PoolStoppingError,
+    ConnectionError,
+    ConnectionTimeoutError,
+    ConnectionClosedError,
+)
+from .events import EventEmitter
+from .fsm import FSM
+from .cqueue import Queue
+from .utils import (
+    enable_stack_traces,
+    stack_traces_enabled,
+    current_millis,
+    plan_rebalance,
+)
+from .codel import ControlledDelay
+
+# Build staging: these subsystems land in dependency order (SURVEY.md §7.2);
+# the guard comes off when the facade is complete.
+try:
+    from .resolver import (
+        Resolver,
+        DNSResolver,
+        StaticIpResolver,
+        resolver_for_ip_or_domain,
+        config_for_ip_or_domain,
+    )
+    from .pool import ConnectionPool
+    from .cset import ConnectionSet
+    from .agent import HttpAgent, HttpsAgent
+    from .monitor import pool_monitor
+except ModuleNotFoundError as _e:  # pragma: no cover - staged build only
+    if not (_e.name or '').startswith('cueball_tpu.'):
+        raise
+
+__version__ = '1.0.0'
+
+__all__ = [
+    'ConnectionPool', 'ConnectionSet',
+    'Resolver', 'DNSResolver', 'StaticIpResolver',
+    'resolver_for_ip_or_domain', 'config_for_ip_or_domain',
+    'HttpAgent', 'HttpsAgent',
+    'pool_monitor',
+    'EventEmitter', 'FSM', 'Queue', 'ControlledDelay',
+    'enable_stack_traces', 'stack_traces_enabled', 'current_millis',
+    'plan_rebalance',
+    'ClaimHandleMisusedError', 'ClaimTimeoutError', 'NoBackendsError',
+    'PoolFailedError', 'PoolStoppingError', 'ConnectionError',
+    'ConnectionTimeoutError', 'ConnectionClosedError',
+]

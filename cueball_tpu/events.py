@@ -1,0 +1,82 @@
+"""Synchronous event emitter.
+
+The reference is built on Node's EventEmitter contract: synchronous
+delivery in registration order, `once` auto-removal, listener
+introspection for the claim-handle leak detector
+(reference lib/connection-fsm.js:786-808 counts listeners by function
+identity). This is a minimal faithful equivalent for asyncio programs;
+emission is synchronous, scheduling is the caller's concern.
+"""
+
+from __future__ import annotations
+
+import typing
+
+
+class EventEmitter:
+    """Node-style event emitter with synchronous delivery."""
+
+    def __init__(self) -> None:
+        self._ee_listeners: dict[str, list] = {}
+
+    # -- registration ----------------------------------------------------
+
+    def on(self, event: str, listener: typing.Callable) -> typing.Callable:
+        """Register listener; returns it so callers can hold a removal ref."""
+        self._ee_listeners.setdefault(event, []).append(listener)
+        return listener
+
+    add_listener = on
+
+    def once(self, event: str, listener: typing.Callable) -> typing.Callable:
+        def wrapper(*args, **kwargs):
+            self.remove_listener(event, wrapper)
+            return listener(*args, **kwargs)
+        wrapper.__wrapped_listener__ = listener
+        self.on(event, wrapper)
+        return wrapper
+
+    def remove_listener(self, event: str, listener: typing.Callable) -> None:
+        lst = self._ee_listeners.get(event)
+        if not lst:
+            return
+        for i, entry in enumerate(lst):
+            if entry is listener or \
+                    getattr(entry, '__wrapped_listener__', None) is listener:
+                del lst[i]
+                break
+        if not lst:
+            self._ee_listeners.pop(event, None)
+
+    def remove_all_listeners(self, event: str | None = None) -> None:
+        if event is None:
+            self._ee_listeners.clear()
+        else:
+            self._ee_listeners.pop(event, None)
+
+    # -- introspection ---------------------------------------------------
+
+    def listeners(self, event: str) -> list:
+        return list(self._ee_listeners.get(event, ()))
+
+    def listener_count(self, event: str) -> int:
+        return len(self._ee_listeners.get(event, ()))
+
+    def event_names(self) -> list[str]:
+        return [k for k, v in self._ee_listeners.items() if v]
+
+    # -- emission --------------------------------------------------------
+
+    def emit(self, event: str, *args) -> bool:
+        """Deliver synchronously to a snapshot of current listeners.
+
+        Returns True if anyone was listening (Node contract; the Set's
+        assert_emit crash-if-unhandled check relies on this,
+        reference lib/set.js:471-479).
+        """
+        lst = self._ee_listeners.get(event)
+        if not lst:
+            return False
+        for listener in list(lst):
+            listener(*args)
+        return True

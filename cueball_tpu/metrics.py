@@ -1,0 +1,106 @@
+"""Prometheus-style metric collector (artedi equivalent).
+
+The reference depends on Joyent's `artedi` for its error-event counter
+(reference lib/utils.js:24,395-444; README.adoc:113,137 documents sharing a
+collector across pools/agents). This is a minimal compatible rebuild:
+label-keyed counters/gauges/histograms with a text-format serializer.
+"""
+
+from __future__ import annotations
+
+import threading
+import typing
+
+
+def _label_key(labels: dict | None) -> tuple:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    metric_type = 'counter'
+
+    def __init__(self, name: str, help: str = '',
+                 static_labels: dict | None = None):
+        self.name = name
+        self.help = help
+        self._static = dict(static_labels or {})
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def increment(self, labels: dict | None = None, value: float = 1) -> None:
+        merged = dict(self._static)
+        merged.update(labels or {})
+        key = _label_key(merged)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + value
+
+    add = increment
+
+    def value(self, labels: dict | None = None) -> float:
+        merged = dict(self._static)
+        merged.update(labels or {})
+        return self._values.get(_label_key(merged), 0)
+
+    def total(self) -> float:
+        return sum(self._values.values())
+
+    def serialize(self) -> str:
+        out = ['# HELP %s %s' % (self.name, self.help),
+               '# TYPE %s %s' % (self.name, self.metric_type)]
+        for key, v in sorted(self._values.items()):
+            lbl = ','.join('%s="%s"' % (k, val) for k, val in key)
+            out.append('%s{%s} %g' % (self.name, lbl, v))
+        return '\n'.join(out) + '\n'
+
+
+class Gauge(Counter):
+    metric_type = 'gauge'
+
+    def set(self, value: float, labels: dict | None = None) -> None:
+        merged = dict(self._static)
+        merged.update(labels or {})
+        with self._lock:
+            self._values[_label_key(merged)] = value
+
+
+class Collector:
+    """Registry of named metrics; counter() declarations are idempotent
+    (the reference relies on this when an agent-created collector is passed
+    down into pools, lib/utils.js:405-416)."""
+
+    def __init__(self, labels: dict | None = None):
+        self._labels = dict(labels or {})
+        self._metrics: dict[str, Counter] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str = '') -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name, help, self._labels)
+                self._metrics[name] = m
+            return m
+
+    def gauge(self, name: str, help: str = '') -> Gauge:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Gauge(name, help, self._labels)
+                self._metrics[name] = m
+            assert isinstance(m, Gauge)
+            return m
+
+    def get_collector(self, name: str) -> Counter:
+        return self._metrics[name]
+
+    getCollector = get_collector
+
+    def collect(self) -> str:
+        """Serialize all metrics in Prometheus text format."""
+        return ''.join(m.serialize() for m in self._metrics.values())
+
+
+def create_collector(labels: dict | None = None) -> Collector:
+    return Collector(labels)

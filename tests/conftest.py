@@ -1,0 +1,38 @@
+"""Shared test scaffolding.
+
+- Forces JAX onto a virtual 8-device CPU mesh (set before any jax import)
+  so sharding tests run without TPU hardware.
+- `run_async` drives coroutine-based tests without pytest-asyncio.
+- `Waiter` utilities mirror the reference's setImmediate step-ladder style
+  (reference test/pool.test.js timing patterns).
+"""
+
+import asyncio
+import os
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_xf = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _xf:
+    os.environ['XLA_FLAGS'] = (
+        _xf + ' --xla_force_host_platform_device_count=8').strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_async(coro, timeout=30.0):
+    """Run a test coroutine with a hard timeout."""
+    async def _with_timeout():
+        return await asyncio.wait_for(coro, timeout)
+    return asyncio.run(_with_timeout())
+
+
+async def settle(n=10):
+    """Let the event loop drain n rounds of call_soon callbacks
+    (the setImmediate step-ladder analogue)."""
+    for _ in range(n):
+        await asyncio.sleep(0)
+
+
+async def wait_ms(ms):
+    await asyncio.sleep(ms / 1000.0)

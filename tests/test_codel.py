@@ -1,0 +1,80 @@
+"""Unit tests for the CoDel controlled-delay algorithm
+(reference lib/codel.js; the statistical load test lives in
+test_pool_codel.py once the pool exists)."""
+
+import time
+
+import pytest
+
+from cueball_tpu.codel import ControlledDelay, CODEL_INTERVAL
+from cueball_tpu.utils import current_millis
+
+
+def test_ctor_validates():
+    ControlledDelay(500)
+    with pytest.raises(AssertionError):
+        ControlledDelay(float('inf'))
+    with pytest.raises(AssertionError):
+        ControlledDelay('x')
+
+
+def test_below_target_never_drops():
+    cd = ControlledDelay(10000)
+    now = current_millis()
+    for _ in range(100):
+        assert not cd.overloaded(now)  # sojourn ~0 << target
+
+
+def test_sustained_overload_starts_dropping():
+    cd = ControlledDelay(1)  # 1ms target
+    start = current_millis() - 500  # claim queued 500ms ago
+    dropped = False
+    # Needs one full control interval above target before dropping.
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        if cd.overloaded(start):
+            dropped = True
+            break
+        time.sleep(0.005)
+    assert dropped
+    assert cd.cd_dropping
+    assert cd.cd_count >= 1
+
+
+def test_drop_rate_increases_with_count():
+    cd = ControlledDelay(1)
+    start = current_millis() - 1000
+    drops = 0
+    deadline = time.monotonic() + 1.0
+    while time.monotonic() < deadline:
+        if cd.overloaded(start):
+            drops += 1
+        time.sleep(0.002)
+    # With count growing, drop_next interval shrinks ~ 1/sqrt(count):
+    # we should see multiple drops within a second.
+    assert drops >= 3
+    assert cd.cd_count >= 3
+
+
+def test_recovery_stops_dropping():
+    cd = ControlledDelay(50)
+    old = current_millis() - 500
+    deadline = time.monotonic() + 1.0
+    while time.monotonic() < deadline and not cd.cd_dropping:
+        cd.overloaded(old)
+        time.sleep(0.005)
+    assert cd.cd_dropping
+    # Fresh claims under target reset the dropping state.
+    assert not cd.overloaded(current_millis())
+    assert not cd.cd_dropping
+
+
+def test_get_max_idle_healthy_vs_overloaded():
+    cd = ControlledDelay(100)
+    # Never emptied: healthy bound 10x.
+    assert cd.get_max_idle() == 1000
+    cd.empty()
+    assert cd.get_max_idle() == 1000
+    # Pretend the last empty was long ago -> persistent overload, 3x.
+    cd.cd_last_empty = current_millis() - 5000
+    assert cd.get_max_idle() == 300

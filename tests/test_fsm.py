@@ -1,0 +1,324 @@
+"""Tests for the Moore FSM runtime (mooremachine-replacement semantics:
+async stateChanged ordering, handler disposal on exit, validTransitions,
+sub-states, history — reference docs/internals.adoc:115-131)."""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu.events import EventEmitter
+from cueball_tpu.fsm import FSM, add_transition_tracer, \
+    remove_transition_tracer
+
+from conftest import run_async, settle
+
+
+class Light(FSM):
+    def __init__(self):
+        self.entries = []
+        super().__init__('red')
+
+    def state_red(self, S):
+        self.entries.append('red')
+        S.validTransitions(['green'])
+
+    def state_green(self, S):
+        self.entries.append('green')
+        S.validTransitions(['red', 'yellow'])
+
+    def state_yellow(self, S):
+        self.entries.append('yellow')
+        S.validTransitions(['red'])
+
+    def go(self, state):
+        self._goto_state(state)
+
+
+def test_initial_state_entered_synchronously():
+    async def t():
+        l = Light()
+        assert l.get_state() == 'red'
+        assert l.entries == ['red']
+    run_async(t())
+
+
+def test_valid_transitions_enforced():
+    async def t():
+        l = Light()
+        with pytest.raises(RuntimeError):
+            l.go('yellow')  # red -> yellow not allowed
+        l.go('green')
+        assert l.get_state() == 'green'
+    run_async(t())
+
+
+def test_state_changed_emitted_async_in_order():
+    async def t():
+        l = Light()
+        seen = []
+        l.on('stateChanged', seen.append)
+        l.go('green')
+        l.go('yellow')
+        # Emission is deferred (setImmediate analogue): nothing yet --
+        # including the initial 'red' from construction.
+        assert seen == []
+        await settle()
+        assert seen == ['red', 'green', 'yellow']
+    run_async(t())
+
+
+def test_listeners_disposed_on_exit():
+    async def t():
+        em = EventEmitter()
+        fired = []
+
+        class M(FSM):
+            def __init__(self):
+                super().__init__('a')
+
+            def state_a(self, S):
+                S.on(em, 'ping', lambda: fired.append('a'))
+
+            def state_b(self, S):
+                S.on(em, 'ping', lambda: fired.append('b'))
+
+        m = M()
+        em.emit('ping')
+        assert fired == ['a']
+        m._goto_state('b')
+        em.emit('ping')
+        assert fired == ['a', 'b']
+    run_async(t())
+
+
+def test_timers_cancelled_on_exit():
+    async def t():
+        fired = []
+
+        class M(FSM):
+            def __init__(self):
+                super().__init__('a')
+
+            def state_a(self, S):
+                S.timeout(10, lambda: fired.append('a-timer'))
+
+            def state_b(self, S):
+                pass
+
+        m = M()
+        m._goto_state('b')
+        await asyncio.sleep(0.03)
+        assert fired == []
+    run_async(t())
+
+
+def test_goto_state_timeout_and_interval():
+    async def t():
+        ticks = []
+
+        class M(FSM):
+            def __init__(self):
+                super().__init__('a')
+
+            def state_a(self, S):
+                S.interval(5, lambda: ticks.append(1))
+                S.gotoStateTimeout(30, 'b')
+
+            def state_b(self, S):
+                pass
+
+        m = M()
+        await asyncio.sleep(0.1)
+        assert m.get_state() == 'b'
+        n = len(ticks)
+        assert n >= 2
+        await asyncio.sleep(0.03)
+        assert len(ticks) == n  # interval stopped on exit
+    run_async(t())
+
+
+def test_substates_and_is_in_state():
+    async def t():
+        order = []
+
+        class M(FSM):
+            def __init__(self):
+                super().__init__('run')
+
+            def state_run(self, S):
+                S.validTransitions(['stop'])
+
+            def state_stop(self, S):
+                order.append('stop')
+                S.validTransitions(['stop.inner'])
+                S.gotoState('stop.inner')
+
+            def state_stop_inner(self, S):
+                order.append('stop.inner')
+                S.validTransitions(['done'])
+
+            def state_done(self, S):
+                pass
+
+        m = M()
+        m._goto_state('stop')
+        assert order == ['stop', 'stop.inner']
+        assert m.get_state() == 'stop.inner'
+        assert m.is_in_state('stop')        # prefix match
+        assert m.is_in_state('stop.inner')
+        assert not m.is_in_state('sto')
+        seen = []
+        m.on('stateChanged', seen.append)
+        await settle()
+        # Deferred emissions queued before we subscribed still deliver
+        # (setImmediate semantics), in transition order.
+        assert seen == ['run', 'stop', 'stop.inner']
+        assert m.get_history()[-2:] == ['stop', 'stop.inner']
+    run_async(t())
+
+
+def test_reentrant_goto_serialized():
+    async def t():
+        order = []
+
+        class M(FSM):
+            def __init__(self):
+                super().__init__('a')
+
+            def state_a(self, S):
+                order.append('a-begin')
+                S.gotoState('b')
+                order.append('a-end')
+
+            def state_b(self, S):
+                order.append('b')
+
+        m = M()
+        # state_a's entry completes before b is entered.
+        assert order == ['a-begin', 'a-end', 'b']
+        assert m.get_state() == 'b'
+        seen = []
+        m.on('stateChanged', seen.append)
+        await settle()
+    run_async(t())
+
+
+def test_stale_handle_callbacks_gated():
+    async def t():
+        em = EventEmitter()
+        fired = []
+
+        class M(FSM):
+            def __init__(self):
+                super().__init__('a')
+
+            def state_a(self, S):
+                # Handler that transitions, then a second handler on the
+                # same event: the second must not run (state changed).
+                S.on(em, 'kick', lambda: S.gotoState('b'))
+                S.on(em, 'kick', lambda: fired.append('stale'))
+
+            def state_b(self, S):
+                pass
+
+        m = M()
+        em.emit('kick')
+        assert m.get_state() == 'b'
+        assert fired == []
+    run_async(t())
+
+
+def test_all_state_event_crashes_when_unhandled():
+    async def t():
+        class M(FSM):
+            def __init__(self):
+                super().__init__('a')
+                self.all_state_event('sig')
+
+            def state_a(self, S):
+                pass
+
+        m = M()
+        with pytest.raises(RuntimeError):
+            m.emit('sig')
+    run_async(t())
+
+
+def test_transition_tracer_hook():
+    async def t():
+        trace = []
+
+        def tracer(fsm, old, new):
+            trace.append((old, new))
+        add_transition_tracer(tracer)
+        try:
+            l = Light()
+            l.go('green')
+        finally:
+            remove_transition_tracer(tracer)
+        assert trace == [(None, 'red'), ('red', 'green')]
+    run_async(t())
+
+
+def test_history_ring_buffer():
+    async def t():
+        l = Light()
+        for _ in range(6):
+            l.go('green')
+            l.go('red')
+        h = l.get_history()
+        assert len(h) == FSM.HISTORY_LENGTH
+        assert h[-1] == 'red'
+    run_async(t())
+
+
+def test_double_goto_from_same_handle_raises():
+    async def t():
+        errors = []
+
+        class M(FSM):
+            def __init__(self):
+                super().__init__('a')
+
+            def state_a(self, S):
+                S.gotoState('b')
+                try:
+                    S.gotoState('c')
+                except RuntimeError as e:
+                    errors.append(e)
+
+            def state_b(self, S):
+                pass
+
+            def state_c(self, S):
+                pass
+
+        m = M()
+        assert m.get_state() == 'b'
+        assert len(errors) == 1
+    run_async(t())
+
+
+def test_queued_transition_validated_against_intermediate_state():
+    async def t():
+        class M(FSM):
+            def __init__(self):
+                super().__init__('a')
+
+            def state_a(self, S):
+                S.gotoState('b')
+
+            def state_b(self, S):
+                S.validTransitions(['done'])
+                # Queue an illegal hop from within b's own entry.
+                S.gotoState('c')
+
+            def state_c(self, S):
+                pass
+
+            def state_done(self, S):
+                pass
+
+        with pytest.raises(RuntimeError, match='invalid transition'):
+            M()
+    run_async(t())

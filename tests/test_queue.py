@@ -1,0 +1,65 @@
+"""Tests for the intrusive queue (reference lib/queue.js semantics)."""
+
+from cueball_tpu.cqueue import Queue
+
+
+def test_fifo_order():
+    q = Queue()
+    assert q.is_empty()
+    q.push(1)
+    q.push(2)
+    q.push(3)
+    assert len(q) == 3
+    assert q.peek() == 1
+    assert q.shift() == 1
+    assert q.shift() == 2
+    assert q.shift() == 3
+    assert q.shift() is None
+    assert q.is_empty()
+
+
+def test_middle_removal_o1():
+    q = Queue()
+    n1 = q.push('a')
+    n2 = q.push('b')
+    n3 = q.push('c')
+    n2.remove()
+    assert len(q) == 2
+    assert not n2.is_queued()
+    assert list(q) == ['a', 'c']
+    n1.remove()
+    n3.remove()
+    assert q.is_empty()
+
+
+def test_remove_idempotent():
+    q = Queue()
+    n = q.push('x')
+    n.remove()
+    n.remove()  # second remove is a no-op
+    assert len(q) == 0
+    q.push('y')
+    assert list(q) == ['y']
+
+
+def test_removal_during_iteration():
+    q = Queue()
+    nodes = [q.push(i) for i in range(5)]
+    seen = []
+    for v in q:
+        seen.append(v)
+        if v == 2:
+            nodes[3].remove()
+    assert seen == [0, 1, 2, 4]
+
+
+def test_interleaved_push_shift():
+    q = Queue()
+    q.push(1)
+    q.push(2)
+    assert q.shift() == 1
+    q.push(3)
+    assert [v for v in q] == [2, 3]
+    assert q.shift() == 2
+    assert q.shift() == 3
+    assert q.is_empty()

@@ -1,0 +1,327 @@
+"""Tests for cueball_tpu.utils.
+
+The plan_rebalance cases are the reference's full planning table
+(reference test/utils.test.js), ported case-for-case: SURVEY.md §7.4 calls
+this out as a hard part to pin down before pool integration.
+"""
+
+import pytest
+
+from cueball_tpu import utils
+
+
+# ---------------------------------------------------------------------------
+# plan_rebalance table (reference test/utils.test.js)
+
+def test_rebalance_simple_addition():
+    plan = utils.plan_rebalance({'b1': []}, {}, 4, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == ['b1', 'b1', 'b1', 'b1']
+
+
+def test_rebalance_addition_over_2_options():
+    plan = utils.plan_rebalance({'b1': [], 'b2': []}, {}, 5, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == ['b1', 'b1', 'b1', 'b2', 'b2']
+
+
+def test_rebalance_add_with_existing():
+    plan = utils.plan_rebalance({'b1': ['c1'], 'b2': ['c2']}, {}, 4, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == ['b1', 'b2']
+
+
+def test_rebalance_add_none():
+    plan = utils.plan_rebalance(
+        {'b1': ['c1', 'c3'], 'b2': ['c2', 'c4']}, {}, 4, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == []
+
+
+def test_rebalance_add_and_remove():
+    plan = utils.plan_rebalance(
+        {'b1': ['c1', 'c2', 'c3'], 'b2': ['c4']}, {}, 4, 10)
+    assert len(plan['remove']) == 1
+    assert plan['remove'][0] in ['c1', 'c2', 'c3']
+    assert plan['add'] == ['b2']
+
+
+def test_rebalance_add_from_unbalanced():
+    plan = utils.plan_rebalance(
+        {'b1': ['c1', 'c2', 'c3'], 'b2': ['c4']}, {}, 6, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == ['b2', 'b2']
+
+
+def test_rebalance_shrink():
+    plan = utils.plan_rebalance(
+        {'b1': ['c1', 'c2', 'c3'], 'b2': ['c4', 'c5', 'c6']}, {}, 4, 10)
+    assert plan['remove'] == ['c4', 'c1']
+    assert plan['add'] == []
+
+
+def test_rebalance_lots_of_nodes():
+    spares = {'b1': ['c1', 'c2', 'c3', 'c4'], 'b2': [], 'b3': [],
+              'b4': [], 'b5': [], 'b6': [], 'b7': []}
+    plan = utils.plan_rebalance(spares, {}, 5, 10)
+    assert plan['remove'] == ['c1', 'c2', 'c3']
+    assert plan['add'] == ['b2', 'b3', 'b4', 'b5']
+
+
+def test_rebalance_more_nodes():
+    spares = {'b3': [], 'b1': [], 'b2': [], 'b4': [],
+              'b5': ['c1', 'c2', 'c3', 'c4'], 'b6': [], 'b7': []}
+    plan = utils.plan_rebalance(spares, {}, 6, 10)
+    assert plan['remove'] == ['c1', 'c2', 'c3']
+    assert plan['add'] == ['b3', 'b1', 'b2', 'b4', 'b6']
+
+
+def test_rebalance_excess_spread_out():
+    spares = {'b3': ['c1'], 'b1': ['c2'], 'b2': ['c3'], 'b4': ['c4'],
+              'b5': ['c5'], 'b6': ['c6'], 'b7': []}
+    plan = utils.plan_rebalance(spares, {}, 3, 10)
+    assert plan['remove'] == ['c6', 'c5', 'c4']
+    assert plan['add'] == []
+
+
+def test_rebalance_odd_number():
+    plan = utils.plan_rebalance({'b3': ['c1'], 'b1': [], 'b2': []}, {}, 4, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == ['b3', 'b1', 'b2']
+
+
+def test_rebalance_reordering():
+    plan = utils.plan_rebalance(
+        {'b2': [], 'b1': ['c1'], 'b3': ['c2']}, {}, 2, 10)
+    assert plan['remove'] == ['c2']
+    assert plan['add'] == ['b2']
+
+
+def test_rebalance_dead_replacement():
+    plan = utils.plan_rebalance(
+        {'b1': [], 'b2': [], 'b3': []}, {'b1': True}, 2, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == ['b1', 'b2', 'b3']
+
+
+def test_rebalance_dead_replacement_and_shrink():
+    plan = utils.plan_rebalance(
+        {'b1': ['c1', 'c3'], 'b2': ['c2'], 'b3': []}, {'b1': True}, 3, 10)
+    assert plan['remove'] == ['c1']
+    assert plan['add'] == ['b2', 'b3']
+
+
+def test_rebalance_dead_again():
+    plan = utils.plan_rebalance(
+        {'b1': ['c1'], 'b2': ['c2']}, {'b1': True}, 1, 2)
+    assert plan['remove'] == []
+    assert plan['add'] == []
+
+
+def test_rebalance_nested_dead():
+    plan = utils.plan_rebalance(
+        {'b1': [], 'b2': ['c2'], 'b3': [], 'b4': []},
+        {'b1': True, 'b3': True}, 2, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == ['b1', 'b3', 'b4']
+
+
+def test_rebalance_nested_dead_with_cap():
+    plan = utils.plan_rebalance(
+        {'b1': [], 'b2': ['c2'], 'b3': [], 'b4': []},
+        {'b1': True, 'b3': True}, 2, 3)
+    assert plan['remove'] == []
+    assert plan['add'] == ['b1', 'b4']
+
+
+def test_rebalance_dead_backend_starvation_1():
+    plan = utils.plan_rebalance({'b1': ['c1']}, {'b1': True}, 2, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == []
+
+
+def test_rebalance_dead_backend_starvation_2():
+    plan = utils.plan_rebalance(
+        {'b1': ['c1'], 'b2': []}, {'b1': True}, 3, 10)
+    assert plan['remove'] == []
+    assert plan['add'] == ['b2', 'b2', 'b2']
+
+
+def test_rebalance_bug_30():
+    spares = {
+        '16uN6JsJFild9cHyl2+LSyRHmNc=': ['c1'],
+        'c7QG0UOYCpm6m/hYUX0jBenbM70=': ['c2'],
+        'ashWtupYHh1QH33UP/T2+6hvi8c=': [],
+        '4QMg6SChOmtF8s6lfK32lLoKUFs=': [],
+    }
+    dead = {
+        'c7QG0UOYCpm6m/hYUX0jBenbM70=': True,
+        '16uN6JsJFild9cHyl2+LSyRHmNc=': True,
+        '4QMg6SChOmtF8s6lfK32lLoKUFs=': True,
+        'ashWtupYHh1QH33UP/T2+6hvi8c=': True,
+    }
+    plan = utils.plan_rebalance(spares, dead, 3, 4)
+    assert plan['remove'] == []
+    assert plan['add'] == [
+        'ashWtupYHh1QH33UP/T2+6hvi8c=', '4QMg6SChOmtF8s6lfK32lLoKUFs=']
+
+
+def test_rebalance_singleton_one_per_backend():
+    # Set planning: even with target 5, each backend gets at most one.
+    plan = utils.plan_rebalance({'b1': [], 'b2': []}, {}, 5, 10,
+                                singleton=True)
+    assert plan['add'] == ['b1', 'b2']
+
+
+# ---------------------------------------------------------------------------
+# recovery validation (reference lib/utils.js:116-186)
+
+def _good_recovery():
+    return {'retries': 3, 'timeout': 1000, 'delay': 100}
+
+
+def test_assert_recovery_accepts_good():
+    utils.assert_recovery(_good_recovery())
+    utils.assert_recovery({'retries': 2, 'timeout': 100, 'maxTimeout': 2000,
+                           'delay': 50, 'maxDelay': 5000,
+                           'delaySpread': 0.5})
+
+
+def test_assert_recovery_rejects_unknown_keys():
+    r = _good_recovery()
+    r['bogus'] = 1
+    with pytest.raises(AssertionError):
+        utils.assert_recovery(r)
+
+
+def test_assert_recovery_rejects_missing_fields():
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': 3, 'timeout': 1000})
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': 3, 'delay': 100})
+
+
+def test_assert_recovery_rejects_bad_values():
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': -1, 'timeout': 100, 'delay': 10})
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': 1, 'timeout': 0, 'delay': 10})
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': 1, 'timeout': 100, 'delay': 10,
+                               'maxTimeout': 50})
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': 1, 'timeout': 100, 'delay': 10,
+                               'maxDelay': 5})
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': 1, 'timeout': 100, 'delay': 10,
+                               'delaySpread': 1.5})
+
+
+def test_assert_recovery_requires_caps_for_exponential_blowup():
+    # retries >= 32 without maxDelay/maxTimeout must be rejected.
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': 40, 'timeout': 100, 'delay': 10})
+    # Large delay * 2^retries over a day must be rejected.
+    with pytest.raises(AssertionError):
+        utils.assert_recovery(
+            {'retries': 30, 'timeout': 100, 'maxTimeout': 1000,
+             'delay': 100000})
+    # ... but fine with explicit caps.
+    utils.assert_recovery({'retries': 40, 'timeout': 100, 'maxTimeout': 1000,
+                           'delay': 10, 'maxDelay': 1000})
+
+
+def test_assert_recovery_set():
+    utils.assert_recovery_set({'default': _good_recovery(),
+                               'dns': _good_recovery()})
+    with pytest.raises(AssertionError):
+        utils.assert_recovery_set({'default': {'retries': 1}})
+
+
+def test_assert_claim_delay():
+    utils.assert_claim_delay(None)
+    utils.assert_claim_delay(500)
+    with pytest.raises(AssertionError):
+        utils.assert_claim_delay(0)
+    with pytest.raises(AssertionError):
+        utils.assert_claim_delay(10.5)
+
+
+# ---------------------------------------------------------------------------
+# delay / shuffle / clock
+
+def test_gen_delay_spread_bounds():
+    for _ in range(200):
+        d = utils.gen_delay(1000, 0.2)
+        assert 900 <= d <= 1100
+    for _ in range(200):
+        d = utils.gen_delay({'delay': 500, 'delaySpread': 1.0})
+        assert 250 <= d <= 750
+    # default spread 0.2
+    for _ in range(200):
+        d = utils.gen_delay(1000)
+        assert 900 <= d <= 1100
+
+
+def test_shuffle_permutation():
+    arr = list(range(50))
+    out = utils.shuffle(list(arr))
+    assert sorted(out) == arr
+
+
+def test_current_millis_monotonic():
+    a = utils.current_millis()
+    b = utils.current_millis()
+    assert b >= a
+
+
+def test_stack_trace_gating():
+    assert not utils.stack_traces_enabled()
+    fake = utils.maybe_capture_stack_trace()
+    assert 'stack traces disabled' in fake['stack']
+    utils.enable_stack_traces()
+    try:
+        real = utils.maybe_capture_stack_trace()
+        assert 'test_utils' in real['stack']
+    finally:
+        utils.disable_stack_traces()
+
+
+def test_error_metrics_whitelist():
+    coll = utils.create_error_metrics({})
+    utils.update_error_metrics(coll, 'uuid-1', 'claim-timeout')
+    utils.update_error_metrics(coll, 'uuid-1', 'not-a-real-event')
+    counter = coll.get_collector(utils.METRIC_CUEBALL_EVENT_COUNTER)
+    assert counter.total() == 1
+    # Idempotent declaration on a shared collector.
+    coll2 = utils.create_error_metrics({'collector': coll})
+    assert coll2 is coll
+    assert counter.total() == 1
+
+
+def test_assert_recovery_rejects_infinite_values():
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': 1, 'timeout': float('inf'),
+                               'maxTimeout': float('inf'), 'delay': 10,
+                               'maxDelay': 100})
+    with pytest.raises(AssertionError):
+        utils.assert_recovery({'retries': 1, 'timeout': 100,
+                               'maxTimeout': 200, 'delay': float('inf'),
+                               'maxDelay': float('inf')})
+
+
+def test_assert_claim_delay_rejects_inf_nan_as_assertion():
+    with pytest.raises(AssertionError):
+        utils.assert_claim_delay(float('inf'))
+    with pytest.raises(AssertionError):
+        utils.assert_claim_delay(float('nan'))
+
+
+def test_gauge_serialization_type_line():
+    from cueball_tpu import metrics
+    coll = metrics.create_collector()
+    g = coll.gauge('open_conns', help='Live counter of open connections')
+    g.set(3, {'pool': 'p1'})
+    text = g.serialize()
+    assert '# TYPE open_conns gauge' in text
+    assert 'Live counter of open connections' in text
