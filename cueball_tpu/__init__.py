@@ -46,27 +46,33 @@ from .utils import (
 )
 from .codel import ControlledDelay
 
-# Build staging: these subsystems land in dependency order (SURVEY.md §7.2);
-# the guard comes off when the facade is complete.
+from .resolver import (
+    Resolver,
+    DNSResolver,
+    StaticIpResolver,
+    resolver_for_ip_or_domain,
+    config_for_ip_or_domain,
+)
+from .pool import ConnectionPool
+from .monitor import pool_monitor
+
+# Build staging (SURVEY.md §7.2): each remaining subsystem is guarded
+# individually so one missing module neither hides another nor breaks
+# `import *`; __all__ is built from the names actually bound.
 try:
-    from .resolver import (
-        Resolver,
-        DNSResolver,
-        StaticIpResolver,
-        resolver_for_ip_or_domain,
-        config_for_ip_or_domain,
-    )
-    from .pool import ConnectionPool
     from .cset import ConnectionSet
-    from .agent import HttpAgent, HttpsAgent
-    from .monitor import pool_monitor
 except ModuleNotFoundError as _e:  # pragma: no cover - staged build only
-    if not (_e.name or '').startswith('cueball_tpu.'):
+    if (_e.name or '') != 'cueball_tpu.cset':
+        raise
+try:
+    from .agent import HttpAgent, HttpsAgent
+except ModuleNotFoundError as _e:  # pragma: no cover - staged build only
+    if (_e.name or '') != 'cueball_tpu.agent':
         raise
 
 __version__ = '1.0.0'
 
-__all__ = [
+__all__ = [n for n in [
     'ConnectionPool', 'ConnectionSet',
     'Resolver', 'DNSResolver', 'StaticIpResolver',
     'resolver_for_ip_or_domain', 'config_for_ip_or_domain',
@@ -78,4 +84,4 @@ __all__ = [
     'ClaimHandleMisusedError', 'ClaimTimeoutError', 'NoBackendsError',
     'PoolFailedError', 'PoolStoppingError', 'ConnectionError',
     'ConnectionTimeoutError', 'ConnectionClosedError',
-]
+] if n in globals()]

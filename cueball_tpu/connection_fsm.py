@@ -1,0 +1,844 @@
+"""The slot stack: SocketMgrFSM, CueBallClaimHandle, ConnectionSlotFSM.
+
+Rebuild of reference `lib/connection-fsm.js`. Three interlocking Moore
+machines manage each pool/set "slot":
+
+- :class:`SocketMgrFSM` wraps one live "connection" at a time (constructed
+  via the user-supplied ``constructor(backend)``), deduplicates
+  connect/error/close/timeout events, and implements exponential backoff
+  with randomized spread and a "monitor" mode (infinite retries pinned at
+  max backoff) used to probe dead backends
+  (reference lib/connection-fsm.js:68-425).
+- :class:`CueBallClaimHandle` is the FSM handed to users on claim():
+  waiting→claiming→claimed→released/closed (+cancelled/failed), running
+  the double-handshake with the slot (try→claim→accept/reject) that
+  closes the claim-vs-disconnect race, claim timeouts, and leaked-
+  event-handler detection on release
+  (reference lib/connection-fsm.js:427-808, docs/internals.adoc:454-477).
+- :class:`ConnectionSlotFSM` drives the SocketMgr (when to retry vs.
+  reconnect vs. stop), honors the pool's ``wanted`` flag, accepts claims,
+  converts a monitor slot into a normal slot on success, and schedules
+  idle-time health checks (reference lib/connection-fsm.js:810-1242).
+
+Connection interface expected from ``constructor(backend)`` (reference
+docs/api.adoc:580-645): an EventEmitter emitting ``connect``, ``error``,
+``close`` (and optionally ``connectError``, ``timeout``,
+``connectTimeout``) with a ``destroy()`` method; optionally
+``ref()/unref()``, ``setUnwanted()``, and a ``localPort`` attribute.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import typing
+
+from . import errors as mod_errors
+from . import utils as mod_utils
+from .fsm import FSM
+
+
+def _assert_obj(v, name):
+    if not isinstance(v, dict) and v is None:
+        raise AssertionError('%s is required' % name)
+
+
+def count_listeners(emitter, event: str) -> int:
+    """Count user-attached listeners, ignoring the framework's own
+    (reference lib/connection-fsm.js:786-808 filters by function name; we
+    mark internal handlers with a `_cueball_internal` attribute)."""
+    ls = emitter.listeners(event)
+    return len([h for h in ls
+                if callable(h) and
+                not getattr(h, '_cueball_internal', False) and
+                not getattr(getattr(h, '__wrapped_listener__', None),
+                            '_cueball_internal', False)])
+
+
+def _internal(fn):
+    fn._cueball_internal = True
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# SocketMgrFSM
+
+class SocketMgrFSM(FSM):
+    """Owns one connection at a time; states init/connecting/connected/
+    error/backoff/closed/failed (reference lib/connection-fsm.js:85-425).
+
+    Driven by its ConnectionSlotFSM through the signal functions
+    ``connect()``, ``retry()``, ``close()``.
+    """
+
+    def __init__(self, options: dict):
+        constructor = options['constructor']
+        if not callable(constructor):
+            raise AssertionError('options.constructor must be callable')
+        self.sm_pool = options['pool']
+        self.sm_backend = options['backend']
+        self.sm_constructor = constructor
+        self.sm_slot = options['slot']
+
+        recovery = options['recovery']
+        connect_recov = recovery.get('default')
+        initial_recov = recovery.get('default')
+        if recovery.get('connect'):
+            initial_recov = recovery['connect']
+            connect_recov = recovery['connect']
+        if recovery.get('initial'):
+            initial_recov = recovery['initial']
+        mod_utils.assert_recovery(connect_recov, 'recovery.connect')
+        mod_utils.assert_recovery(initial_recov, 'recovery.initial')
+        self.sm_initial_recov = initial_recov
+        self.sm_connect_recov = connect_recov
+
+        self.sm_log = options.get('log') or logging.getLogger(
+            'cueball.socketmgr')
+
+        self.sm_last_error = None
+        self.sm_socket = None
+        self.sm_monitor: bool | None = None
+
+        super().__init__('init')
+        self.set_monitor(bool(options['monitor']))
+
+    # -- knobs -----------------------------------------------------------
+
+    def set_monitor(self, value: bool) -> None:
+        """Toggle monitor mode: infinite retries, no exponential growth —
+        timeout/delay pinned at their max values
+        (reference lib/connection-fsm.js:171-184)."""
+        assert self.is_in_state('init') or self.is_in_state('connected')
+        if value == self.sm_monitor:
+            return
+        self.sm_monitor = value
+        self.reset_backoff()
+
+    setMonitor = set_monitor
+
+    def reset_backoff(self) -> None:
+        r = self.sm_initial_recov
+        self.sm_retries = r['retries']
+        self.sm_retries_left = r['retries']
+        self.sm_min_delay = r['delay']
+        self.sm_delay = r['delay']
+        self.sm_max_delay = r.get('maxDelay') or math.inf
+        self.sm_timeout = r['timeout']
+        self.sm_max_timeout = r.get('maxTimeout') or math.inf
+        self.sm_delay_spread = r.get('delaySpread') or 0.2
+
+        if self.sm_monitor is True:
+            mult = 1 << int(self.sm_retries)
+            self.sm_delay = self.sm_max_delay
+            if not math.isfinite(self.sm_delay):
+                self.sm_delay = r['delay'] * mult
+            self.sm_timeout = self.sm_max_timeout
+            if not math.isfinite(self.sm_timeout):
+                self.sm_timeout = r['timeout'] * mult
+            # Keep retrying a failed backend forever.
+            self.sm_retries = math.inf
+            self.sm_retries_left = math.inf
+
+    resetBackoff = reset_backoff
+
+    def set_unwanted(self) -> None:
+        """Forward to the current socket if it supports it
+        (reference lib/connection-fsm.js:211-222)."""
+        sock = self.sm_socket
+        if sock is not None and \
+                callable(getattr(sock, 'set_unwanted', None)):
+            sock.set_unwanted()
+        elif sock is not None and \
+                callable(getattr(sock, 'setUnwanted', None)):
+            sock.setUnwanted()
+
+    setUnwanted = set_unwanted
+
+    # -- signal functions ------------------------------------------------
+
+    def connect(self) -> None:
+        assert self.is_in_state('init') or self.is_in_state('closed'), (
+            'SocketMgrFSM.connect may only be called in state "init" or '
+            '"closed" (is in "%s")' % self.get_state())
+        self.emit('connectAsserted')
+
+    def retry(self) -> None:
+        assert self.is_in_state('closed') or self.is_in_state('error'), (
+            'SocketMgrFSM.retry may only be called in state "closed" or '
+            '"error" (is in "%s")' % self.get_state())
+        self.emit('retryAsserted')
+
+    def close(self) -> None:
+        assert self.is_in_state('connected') or \
+            self.is_in_state('backoff'), (
+            'SocketMgrFSM.close may only be called in state "connected" '
+            'or "backoff" (is in "%s")' % self.get_state())
+        self.emit('closeAsserted')
+
+    def get_last_error(self):
+        return self.sm_last_error
+
+    getLastError = get_last_error
+
+    def get_socket(self):
+        assert self.is_in_state('connected'), (
+            'sockets may only be retrieved from SocketMgrFSMs in '
+            '"connected" state (is in "%s")' % self.get_state())
+        return self.sm_socket
+
+    getSocket = get_socket
+
+    # -- states ----------------------------------------------------------
+
+    def state_init(self, S):
+        S.validTransitions(['connecting'])
+        S.on(self, 'connectAsserted', lambda: S.gotoState('connecting'))
+
+    def state_connecting(self, S):
+        S.validTransitions(['connected', 'error'])
+
+        def on_timeout():
+            self.sm_last_error = mod_errors.ConnectionTimeoutError(
+                self.sm_backend)
+            S.gotoState('error')
+            self.sm_pool._incr_counter('timeout-during-connect')
+        S.timeout(self.sm_timeout, on_timeout)
+
+        self.sm_log.debug('calling constructor to open new connection')
+        self.sm_socket = self.sm_constructor(self.sm_backend)
+        if self.sm_socket is None:
+            raise AssertionError('constructor returned no connection')
+        self.sm_socket.sm_fsm = self
+
+        S.on(self.sm_socket, 'connect', lambda *a:
+             S.gotoState('connected'))
+
+        @_internal
+        def on_error(err=None):
+            self.sm_last_error = mod_errors.ConnectionError(
+                self.sm_backend, 'error', 'connect', err)
+            S.gotoState('error')
+            self.sm_log.debug('emitted error while connecting: %r', err)
+            self.sm_pool._incr_counter('error-during-connect')
+        S.on(self.sm_socket, 'error', on_error)
+
+        def on_connect_error(err=None):
+            self.sm_last_error = mod_errors.ConnectionError(
+                self.sm_backend, 'connectError', 'connect', err)
+            S.gotoState('error')
+            self.sm_pool._incr_counter('error-during-connect')
+        S.on(self.sm_socket, 'connectError', on_connect_error)
+
+        def on_close(*a):
+            self.sm_last_error = mod_errors.ConnectionClosedError(
+                self.sm_backend)
+            S.gotoState('error')
+            self.sm_log.debug('closed while connecting')
+            self.sm_pool._incr_counter('close-during-connect')
+        S.on(self.sm_socket, 'close', on_close)
+
+        def on_conn_timeout(*a):
+            self.sm_last_error = mod_errors.ConnectionTimeoutError(
+                self.sm_backend)
+            S.gotoState('error')
+            self.sm_log.debug('timed out while connecting')
+            self.sm_pool._incr_counter('timeout-during-connect')
+        S.on(self.sm_socket, 'timeout', on_conn_timeout)
+        S.on(self.sm_socket, 'connectTimeout', on_conn_timeout)
+
+    def state_connected(self, S):
+        S.validTransitions(['error', 'closed'])
+
+        self.sm_log.debug('connected')
+        self.reset_backoff()
+
+        @_internal
+        def on_error(err=None):
+            self.sm_last_error = mod_errors.ConnectionError(
+                self.sm_backend, 'error', 'operation', err)
+            S.gotoState('error')
+            self.sm_pool._incr_counter('error-while-connected')
+            self.sm_log.debug('emitted error while connected: %r', err)
+        S.on(self.sm_socket, 'error', on_error)
+        S.on(self.sm_socket, 'close', lambda *a: S.gotoState('closed'))
+        S.on(self, 'closeAsserted', lambda: S.gotoState('closed'))
+
+    def state_error(self, S):
+        S.validTransitions(['backoff'])
+        if self.sm_socket is not None:
+            self.sm_socket.destroy()
+        self.sm_socket = None
+        S.on(self, 'retryAsserted', lambda: S.gotoState('backoff'))
+
+    def state_backoff(self, S):
+        S.validTransitions(['failed', 'connecting', 'closed'])
+
+        # "retries" means "attempts" in the cueball API; compare to 1
+        # (reference lib/connection-fsm.js:365-371).
+        if self.sm_retries_left != math.inf and self.sm_retries_left <= 1:
+            S.gotoState('failed')
+            return
+
+        delay = mod_utils.delay(self.sm_delay, self.sm_delay_spread)
+
+        if self.sm_retries != math.inf:
+            self.sm_retries_left -= 1
+            self.sm_delay *= 2
+            self.sm_timeout *= 2
+            if self.sm_timeout > self.sm_max_timeout:
+                self.sm_timeout = self.sm_max_timeout
+            if self.sm_delay > self.sm_max_delay:
+                self.sm_delay = self.sm_max_delay
+
+        S.timeout(delay, lambda: S.gotoState('connecting'))
+        S.on(self, 'closeAsserted', lambda: S.gotoState('closed'))
+
+    def state_closed(self, S):
+        S.validTransitions(['backoff', 'connecting'])
+        if self.sm_socket is not None:
+            self.sm_socket.destroy()
+        self.sm_socket = None
+        self.sm_log.debug('connection closed')
+        S.on(self, 'retryAsserted', lambda: S.gotoState('backoff'))
+        S.on(self, 'connectAsserted', lambda: S.gotoState('connecting'))
+
+    def state_failed(self, S):
+        S.validTransitions([])
+        self.sm_log.warning(
+            'failed to connect to backend, retries exhausted: %r',
+            self.sm_last_error)
+        self.sm_pool._incr_counter('retries-exhausted')
+
+
+# ---------------------------------------------------------------------------
+# CueBallClaimHandle
+
+class CueBallClaimHandle(FSM):
+    """FSM handed out to pool users on claim()
+    (reference lib/connection-fsm.js:427-784)."""
+
+    def __init__(self, options: dict):
+        claim_timeout = options['claimTimeout']
+        self.ch_claim_timeout = claim_timeout
+        self.ch_pool = options['pool']
+        throw_error = options.get('throwError')
+        self.ch_throw_error = True if throw_error is None else throw_error
+
+        claim_stack = options['claimStack']
+        if not isinstance(claim_stack, str):
+            raise AssertionError('options.claimStack must be a string')
+        self.ch_claim_stack = [
+            l.strip().removeprefix('at ')
+            for l in claim_stack.split('\n')[1:]]
+
+        callback = options['callback']
+        if not callable(callback):
+            raise AssertionError('options.callback must be callable')
+        self.ch_callback = callback
+
+        self.ch_log = options.get('log') or logging.getLogger(
+            'cueball.claimhandle')
+
+        self.ch_slot = None
+        self.ch_release_stack: list[str] | None = None
+        self.ch_connection = None
+        self.ch_pre_listeners: dict[str, int] = {}
+        self.ch_cancelled = False
+        self.ch_last_error = None
+        self.ch_do_release_leak_check = True
+        self.ch_pinger = False
+        self.ch_started = mod_utils.current_millis()
+
+        super().__init__('waiting')
+
+    # -- misuse traps ----------------------------------------------------
+    # Users sometimes mix up the (handle, connection) callback argument
+    # order; make treating the handle as a socket fail loudly
+    # (reference lib/connection-fsm.js:529-557).
+
+    @property
+    def writable(self):
+        raise mod_errors.ClaimHandleMisusedError()
+
+    @property
+    def readable(self):
+        raise mod_errors.ClaimHandleMisusedError()
+
+    def write(self, *a, **kw):
+        raise mod_errors.ClaimHandleMisusedError()
+
+    def read(self, *a, **kw):
+        raise mod_errors.ClaimHandleMisusedError()
+
+    def on(self, event, listener=None):
+        if event in ('readable', 'close'):
+            raise mod_errors.ClaimHandleMisusedError()
+        return super().on(event, listener)
+
+    def once(self, event, listener=None):
+        if event in ('readable', 'close'):
+            raise mod_errors.ClaimHandleMisusedError()
+        return super().once(event, listener)
+
+    def disable_release_leak_check(self) -> None:
+        self.ch_do_release_leak_check = False
+
+    disableReleaseLeakCheck = disable_release_leak_check
+
+    # -- signal functions ------------------------------------------------
+
+    def try_(self, slot: 'ConnectionSlotFSM') -> None:
+        assert self.is_in_state('waiting'), (
+            'ClaimHandle.try_ may only be called in state "waiting" '
+            '(is in "%s")' % self.get_state())
+        assert slot.is_in_state('idle'), (
+            'ClaimHandle.try_ may only be called on a slot in state '
+            '"idle" (is in "%s")' % slot.get_state())
+        self.ch_slot = slot
+        self.emit('tryAsserted')
+
+    def accept(self, connection) -> None:
+        assert self.is_in_state('claiming')
+        self.ch_connection = connection
+        self.emit('accepted')
+
+    def reject(self) -> None:
+        assert self.is_in_state('claiming')
+        self.emit('rejected')
+
+    def cancel(self) -> None:
+        if self.is_in_state('claimed'):
+            self.release()
+        else:
+            self.ch_cancelled = True
+            self.emit('cancelled')
+
+    def timeout(self) -> None:
+        assert self.is_in_state('waiting')
+        self.emit('timeout')
+
+    def fail(self, err) -> None:
+        self.emit('error', err)
+
+    def _relinquish(self, event: str) -> None:
+        if not self.is_in_state('claimed'):
+            if self.is_in_state('released') or self.is_in_state('closed'):
+                who = self.ch_release_stack[2] if self.ch_release_stack \
+                    and len(self.ch_release_stack) > 2 else 'unknown'
+                raise RuntimeError(
+                    'Connection not claimed by this handle, released '
+                    'by %s' % who)
+            raise RuntimeError(
+                'ClaimHandle.release() called while in state "%s"' %
+                self.get_state())
+        e = mod_utils.maybe_capture_stack_trace()
+        self.ch_release_stack = [
+            l.strip().removeprefix('at ')
+            for l in e['stack'].split('\n')[1:]]
+        self.emit(event)
+
+    def release(self) -> None:
+        self._relinquish('releaseAsserted')
+
+    def close(self) -> None:
+        self._relinquish('closeAsserted')
+
+    def get_last_error(self):
+        return self.ch_last_error
+
+    # -- states ----------------------------------------------------------
+
+    def state_waiting(self, S):
+        S.validTransitions(['claiming', 'cancelled', 'failed'])
+
+        self.ch_slot = None
+
+        S.on(self, 'tryAsserted', lambda: S.gotoState('claiming'))
+
+        def on_timeout():
+            self.ch_last_error = mod_errors.ClaimTimeoutError(self.ch_pool)
+            self.ch_pool._incr_counter('claim-timeout')
+            S.gotoState('failed')
+
+        if isinstance(self.ch_claim_timeout, (int, float)) and \
+                math.isfinite(self.ch_claim_timeout):
+            S.timeout(self.ch_claim_timeout, on_timeout)
+
+        S.on(self, 'timeout', on_timeout)
+
+        def on_error(err):
+            self.ch_last_error = err
+            S.gotoState('failed')
+        S.on(self, 'error', on_error)
+
+        S.on(self, 'cancelled', lambda: S.gotoState('cancelled'))
+
+    def state_claiming(self, S):
+        S.validTransitions(['claimed', 'waiting', 'cancelled'])
+
+        S.on(self, 'accepted', lambda: S.gotoState('claimed'))
+
+        def on_rejected():
+            if self.ch_cancelled:
+                S.gotoState('cancelled')
+            else:
+                S.gotoState('waiting')
+        S.on(self, 'rejected', on_rejected)
+
+        self.ch_slot.claim(self)
+
+    def state_claimed(self, S):
+        S.validTransitions(['released', 'closed'])
+
+        S.on(self, 'releaseAsserted', lambda: S.gotoState('released'))
+        S.on(self, 'closeAsserted', lambda: S.gotoState('closed'))
+
+        if self.ch_cancelled:
+            S.gotoState('released')
+            return
+
+        self.ch_pre_listeners = {}
+        for evt in ('close', 'error', 'readable', 'data'):
+            self.ch_pre_listeners[evt] = count_listeners(
+                self.ch_connection, evt)
+
+        @_internal
+        def on_error(err=None):
+            count = count_listeners(self.ch_connection, 'error')
+            if count == 0 and self.ch_throw_error:
+                # End-user attached no 'error' listener: act like nothing
+                # is listening and raise
+                # (reference lib/connection-fsm.js:697-709).
+                raise err if isinstance(err, BaseException) else \
+                    mod_errors.CueBallError(repr(err))
+            self.ch_log.warning(
+                'connection emitted error while claimed: %r', err)
+            self.ch_pool._incr_counter('error-while-claimed')
+        S.on(self.ch_connection, 'error', on_error)
+
+        self.ch_callback(None, self, self.ch_connection)
+
+    def state_released(self, S):
+        S.validTransitions([])
+        if not self.ch_do_release_leak_check:
+            return
+        conn = self.ch_connection
+        for evt in ('close', 'error', 'readable', 'data'):
+            new_count = count_listeners(conn, evt)
+            old_count = self.ch_pre_listeners.get(evt)
+            if old_count is not None and new_count > old_count:
+                self.ch_log.warning(
+                    'connection claimer looks like it leaked event '
+                    'handlers: event=%s before=%d after=%d',
+                    evt, old_count, new_count)
+
+    def state_closed(self, S):
+        S.validTransitions([])
+        # No leak check: the connection is being closed anyway.
+
+    def state_cancelled(self, S):
+        S.validTransitions([])
+        # Public API contract: the callback is never called after
+        # cancel() (reference lib/connection-fsm.js:770-777).
+
+    def state_failed(self, S):
+        S.validTransitions([])
+        S.immediate(lambda: self.ch_callback(self.ch_last_error))
+
+
+# ---------------------------------------------------------------------------
+# ConnectionSlotFSM
+
+class ConnectionSlotFSM(FSM):
+    """One pool/set slot; drives a SocketMgrFSM and reports the
+    transitions its Pool or Set cares about
+    (reference lib/connection-fsm.js:810-1242)."""
+
+    def __init__(self, options: dict):
+        self.csf_pool = options['pool']
+        self.csf_backend = options['backend']
+        self.csf_wanted = True
+        self.csf_handle = None
+        self.csf_prev_handle = None
+        self.csf_monitor = bool(options['monitor'])
+
+        self.csf_checker = options.get('checker')
+        self.csf_check_timeout = options.get('checkTimeout')
+
+        self.csf_log = options.get('log') or logging.getLogger(
+            'cueball.slot')
+
+        self.csf_smgr = SocketMgrFSM({
+            'pool': options['pool'],
+            'constructor': options['constructor'],
+            'backend': options['backend'],
+            'log': options.get('log'),
+            'recovery': options['recovery'],
+            'monitor': bool(options['monitor']),
+            'slot': self,
+        })
+
+        super().__init__('init')
+
+    # -- public interface ------------------------------------------------
+
+    def set_unwanted(self) -> None:
+        if self.csf_wanted is False:
+            return
+        self.csf_wanted = False
+        self.csf_smgr.set_unwanted()
+        self.emit('unwanted')
+
+    setUnwanted = set_unwanted
+
+    def start(self) -> None:
+        assert self.is_in_state('init')
+        self.emit('startAsserted')
+
+    def claim(self, handle: CueBallClaimHandle) -> None:
+        assert self.is_in_state('idle')
+        assert self.csf_handle is None
+        self.csf_handle = handle
+        self.emit('claimAsserted')
+
+    def make_child_logger(self, *a, **kw):
+        return self.csf_log
+
+    makeChildLogger = make_child_logger
+
+    def get_socket_mgr(self) -> SocketMgrFSM:
+        return self.csf_smgr
+
+    getSocketMgr = get_socket_mgr
+
+    def get_backend(self) -> dict:
+        return self.csf_backend
+
+    getBackend = get_backend
+
+    def is_running_ping(self) -> bool:
+        return bool(self.is_in_state('busy') and self.csf_handle and
+                    self.csf_handle.ch_pinger)
+
+    isRunningPing = is_running_ping
+
+    # -- states ----------------------------------------------------------
+
+    def state_init(self, S):
+        S.on(self, 'startAsserted', lambda: S.gotoState('connecting'))
+
+    def state_connecting(self, S):
+        S.validTransitions(['failed', 'retrying', 'idle'])
+        smgr = self.csf_smgr
+
+        def on_changed(st):
+            if st in ('init', 'connecting'):
+                pass
+            elif st == 'failed':
+                S.gotoState('failed')
+            elif st == 'error':
+                S.gotoState('retrying')
+            elif st == 'connected':
+                S.gotoState('idle')
+            else:
+                raise RuntimeError(
+                    'Unhandled smgr state transition: .connect() => '
+                    '"%s"' % st)
+        S.on(smgr, 'stateChanged', on_changed)
+        smgr.connect()
+
+    def state_failed(self, S):
+        S.validTransitions([])
+        assert self.csf_smgr.is_in_state('failed'), 'smgr must be failed'
+
+    def state_retrying(self, S):
+        S.validTransitions(['idle', 'failed', 'retrying', 'stopped',
+                            'stopping'])
+        smgr = self.csf_smgr
+
+        def on_changed(st):
+            if st in ('backoff', 'connecting'):
+                pass
+            elif st == 'failed':
+                S.gotoState('failed')
+            elif st == 'error':
+                if self.csf_monitor and not self.csf_wanted:
+                    S.gotoState('stopped')
+                else:
+                    S.gotoState('retrying')
+            elif st == 'connected':
+                S.gotoState('idle')
+            else:
+                raise RuntimeError(
+                    'Unhandled smgr state transition: .retry() => '
+                    '"%s"' % st)
+        S.on(smgr, 'stateChanged', on_changed)
+
+        def on_unwanted():
+            if self.csf_monitor and smgr.is_in_state('backoff'):
+                S.gotoState('stopping')
+        S.on(self, 'unwanted', on_unwanted)
+
+        smgr.retry()
+
+    def state_idle(self, S):
+        S.validTransitions(['retrying', 'connecting', 'stopping',
+                            'stopped', 'busy'])
+        smgr = self.csf_smgr
+
+        if self.csf_handle is not None:
+            self.csf_prev_handle = self.csf_handle
+        self.csf_handle = None
+
+        # Monitor successfully connected: convert to a normal slot
+        # (reference lib/connection-fsm.js:1053-1057).
+        if self.csf_monitor is True:
+            self.csf_monitor = False
+            smgr.set_monitor(False)
+
+        def on_unwanted():
+            if smgr.is_in_state('connected'):
+                S.gotoState('stopping')
+
+        if not self.csf_wanted:
+            on_unwanted()
+            return
+        S.on(self, 'unwanted', on_unwanted)
+
+        def on_changed(st):
+            if st == 'error':
+                S.gotoState('retrying')
+            elif st == 'closed':
+                if not self.csf_wanted:
+                    S.gotoState('stopped')
+                else:
+                    S.gotoState('connecting')
+            else:
+                raise RuntimeError(
+                    'Unhandled smgr state transition: connected => '
+                    '"%s"' % st)
+        S.on(smgr, 'stateChanged', on_changed)
+
+        S.on(self, 'claimAsserted', lambda: S.gotoState('busy'))
+
+        if self.csf_check_timeout is not None and \
+                self.csf_checker is not None:
+            S.timeout(self.csf_check_timeout,
+                      lambda: do_ping_check(self, self.csf_checker))
+
+    def state_busy(self, S):
+        S.validTransitions(['idle', 'stopping', 'stopped', 'retrying',
+                            'killing', 'connecting'])
+        smgr = self.csf_smgr
+        hdl = self.csf_handle
+        # Track the smgr state via events: a disconnect may have happened
+        # in this same loop turn and its stateChanged not yet delivered
+        # (reference lib/connection-fsm.js:881-889,1130-1139).
+        state = {'smgr': 'connected'}
+
+        def on_smgr_changed(st):
+            state['smgr'] = st
+        S.on(smgr, 'stateChanged', on_smgr_changed)
+
+        def on_release():
+            if state['smgr'] == 'connected':
+                if self.csf_wanted:
+                    S.gotoState('idle')
+                else:
+                    S.gotoState('stopping')
+            elif state['smgr'] == 'closed':
+                if self.csf_wanted:
+                    S.gotoState('connecting')
+                else:
+                    S.gotoState('stopped')
+            elif state['smgr'] == 'error':
+                S.gotoState('retrying')
+            else:
+                raise RuntimeError(
+                    'Handle released while smgr was in unhandled state '
+                    '"%s"' % smgr.get_state())
+
+        def on_close():
+            if state['smgr'] == 'connected':
+                S.gotoState('killing')
+            else:
+                S.gotoState('retrying')
+
+        def on_hdl_changed(st):
+            if st == 'released':
+                on_release()
+            elif st == 'closed':
+                on_close()
+        S.on(hdl, 'stateChanged', on_hdl_changed)
+
+        # The smgr may have already left 'connected' by the time we get
+        # here; if we lost the race, treat it like a release
+        # (reference lib/connection-fsm.js:1183-1196).
+        if smgr.is_in_state('connected'):
+            sock = smgr.get_socket()
+            hdl.accept(sock)
+        else:
+            hdl.reject()
+            self.csf_handle = None
+            on_release()
+
+    def state_killing(self, S):
+        S.validTransitions(['retrying'])
+        smgr = self.csf_smgr
+
+        def on_changed(st):
+            if st in ('closed', 'error'):
+                S.gotoState('retrying')
+        S.on(smgr, 'stateChanged', on_changed)
+
+        # The socket may have closed already with the stateChanged event
+        # still pending; don't double-close
+        # (reference lib/connection-fsm.js:1209-1216).
+        if not smgr.is_in_state('closed') and \
+                not smgr.is_in_state('error'):
+            smgr.close()
+
+    def state_stopping(self, S):
+        S.validTransitions(['stopped'])
+        smgr = self.csf_smgr
+
+        def on_changed(st):
+            if st in ('closed', 'error'):
+                S.gotoState('stopped')
+        S.on(smgr, 'stateChanged', on_changed)
+
+        if not smgr.is_in_state('closed') and \
+                not smgr.is_in_state('error'):
+            smgr.close()
+
+    def state_stopped(self, S):
+        S.validTransitions([])
+        smgr = self.csf_smgr
+        assert smgr.is_in_state('closed') or smgr.is_in_state('error') or \
+            smgr.is_in_state('failed'), 'smgr must be stopped'
+
+
+def do_ping_check(fsm: ConnectionSlotFSM, checker) -> None:
+    """Run the user health 'checker' over an idle slot by claiming it
+    through a private handle (reference lib/connection-fsm.js:1101-1127)."""
+
+    def ping_check_adapter(err, hdl=None, conn=None):
+        # Infinite timeout and no .fail(): err is always None here.
+        assert err is None
+        checker(hdl, conn)
+
+    handle = CueBallClaimHandle({
+        'pool': fsm.csf_pool,
+        'claimStack': ('Error\n'
+                       'at claim\n'
+                       'at cueball.do_ping_check\n'
+                       'at cueball.do_ping_check\n'),
+        'callback': ping_check_adapter,
+        'log': fsm.csf_log,
+        'claimTimeout': math.inf,
+    })
+    handle.ch_pinger = True
+    # If we lose the race back to 'waiting', just drop the handle
+    # (reference lib/connection-fsm.js:1121-1126).
+    handle.try_(fsm)

@@ -1,0 +1,14 @@
+"""DNS service-discovery resolver (reference lib/resolver.js:152-1377).
+
+Full SRV -> AAAA -> A -> process -> sleep workflow with TTL-driven
+refresh. Placeholder during the staged build; completed in the DNS stage
+(SURVEY.md §7.2 stage 7).
+"""
+
+from __future__ import annotations
+
+
+class DNSResolver:  # pragma: no cover - staged build placeholder
+    def __init__(self, options: dict | None = None):
+        raise NotImplementedError(
+            'DNSResolver lands in build stage 7 (SURVEY.md §7.2)')

@@ -15,7 +15,11 @@ class CueBallError(Exception):
 
     def __init__(self, message: str, cause: 'BaseException | None' = None):
         super().__init__(message)
-        self.__cause__ = cause
+        # Only assign when a cause exists: setting __cause__ (even to
+        # None) flips __suppress_context__ and would hide the implicit
+        # exception context from tracebacks.
+        if cause is not None:
+            self.__cause__ = cause
 
     def cause(self) -> 'BaseException | None':
         return self.__cause__

@@ -277,6 +277,9 @@ class FSM(EventEmitter):
                 self._run_transition(nxt)
         finally:
             self._fsm_in_transition = False
+            # A failed transition must not leave stale queued hops to
+            # replay on a later, unrelated goto_state.
+            self._fsm_pending.clear()
 
     def _run_transition(self, state: str) -> None:
         old = self._fsm_state

@@ -1,0 +1,800 @@
+"""ConnectionPool: claim/release leases over DNS-discovered backends.
+
+Rebuild of reference `lib/pool.js`. A pool maintains busy/init/idle
+connection slots per backend, fed by a Resolver's added/removed events:
+
+- spares policy + claim-driven growth to `maximum`
+  (reference lib/pool.js:102-124)
+- low-pass (128-tap EMA FIR @5Hz) damping of pool shrink under recently
+  high load (reference lib/pool.js:37-100,251-262,579-585)
+- per-backend churn rate limiting (reference lib/pool.js:599-662)
+- decoherence shuffle >=60s (reference lib/pool.js:234-245,501-519;
+  rationale docs/internals.adoc:275-386)
+- dead-backend declaration + monitor probe slots + failed-state
+  short-circuit (reference lib/pool.js:771-794,378-426)
+- CoDel claim-queue shedding when targetClaimDelay is set
+  (reference lib/pool.js:195-200,735-753,874-885)
+
+Pool FSM: starting -> running <-> failed -> stopping -> stopping.backends
+-> stopped (reference lib/pool.js:315-487, docs/api.adoc:180-219).
+
+The claim path is callback-based for parity (`claim_cb`), with an
+asyncio-native `claim()` coroutine wrapper returning (handle, connection).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+import typing
+import uuid as mod_uuid
+
+from . import codel as mod_codel
+from . import errors as mod_errors
+from . import utils as mod_utils
+from .connection_fsm import ConnectionSlotFSM, CueBallClaimHandle
+from .cqueue import Queue
+from .events import EventEmitter
+from .fsm import FSM, get_loop
+
+# Low-pass filter parameters (reference lib/pool.js:43-48): 5 Hz sampling,
+# 128-tap EMA with time constant -0.2 -> pass band ~0.25 Hz, -10 dB at
+# 0.5 Hz, -20 dB at 2.5 Hz.
+LP_RATE = 5
+LP_INT = round(1000 / LP_RATE)
+
+
+def gen_taps(count: int, tc: float) -> list[float]:
+    """Generate normalized EMA filter taps (reference lib/pool.js:50-76).
+    `tc` is the decay time constant: negative, fractional; closer to 0.0
+    means lower cutoff frequency and sharper roll-off."""
+    taps = [math.exp(tc * i) for i in range(count)]
+    s = sum(taps)
+    return [t / s for t in taps]
+
+
+LP_TAPS = gen_taps(128, -0.2)
+
+
+class FIRFilter:
+    """FIR filter over a circular buffer (reference lib/pool.js:78-100).
+
+    The pure-Python form is the pool's hot-path implementation (one
+    128-tap dot product per 200ms); `cueball_tpu.ops.fir` holds the
+    batched JAX/TPU form used for fleet-wide telemetry."""
+
+    def __init__(self, taps: list[float]):
+        self.f_taps = taps
+        self.f_buf = [0.0] * len(taps)
+        self.f_ptr = 0
+
+    def put(self, v: float) -> None:
+        self.f_buf[self.f_ptr] = v
+        self.f_ptr += 1
+        if self.f_ptr == len(self.f_taps):
+            self.f_ptr = 0
+
+    def get(self) -> float:
+        i = self.f_ptr - 1
+        if i < 0:
+            i += len(self.f_taps)
+        acc = 0.0
+        for tap in self.f_taps:
+            acc += self.f_buf[i] * tap
+            i -= 1
+            if i < 0:
+                i += len(self.f_taps)
+        return acc
+
+
+class _Interval:
+    """Recurring timer emitting 'timeout' on an EventEmitter (the node
+    setInterval-feeding-an-emitter pattern of reference
+    lib/pool.js:228-262). asyncio timers don't hold the loop open, so no
+    unref() is needed."""
+
+    def __init__(self, ms: float, emitter: EventEmitter):
+        self._ms = ms
+        self._emitter = emitter
+        self._cancelled = False
+        self._handle = None
+        self._schedule()
+
+    def _schedule(self):
+        loop = get_loop()
+        self._handle = loop.call_later(self._ms / 1000.0, self._fire)
+
+    def _fire(self):
+        if self._cancelled:
+            return
+        self._emitter.emit('timeout')
+        if not self._cancelled:
+            self._schedule()
+
+    def cancel(self):
+        self._cancelled = True
+        if self._handle is not None:
+            self._handle.cancel()
+
+
+class ConnectionPool(FSM):
+    """Reference CueBallConnectionPool (lib/pool.js:125-266 ctor)."""
+
+    def __init__(self, options: dict):
+        if not isinstance(options, dict):
+            raise AssertionError('options must be a dict')
+        constructor = options.get('constructor')
+        if not callable(constructor):
+            raise AssertionError('options.constructor must be callable')
+
+        self.p_uuid = str(mod_uuid.uuid4())
+        self.p_constructor = constructor
+
+        domain = options.get('domain')
+        if not isinstance(domain, str):
+            raise AssertionError('options.domain must be a string')
+        self.p_domain = domain
+        mod_utils.assert_claim_delay(options.get('targetClaimDelay'))
+
+        recovery = options.get('recovery')
+        mod_utils.assert_recovery_set(recovery or {})
+        if not recovery or 'default' not in recovery:
+            raise AssertionError('options.recovery.default is required')
+        self.p_recovery = recovery
+
+        self.p_log = options.get('log') or logging.getLogger('cueball.pool')
+
+        self.p_collector = mod_utils.create_error_metrics(options)
+
+        spares = options.get('spares')
+        maximum = options.get('maximum')
+        if not isinstance(spares, int) or not isinstance(maximum, int):
+            raise AssertionError(
+                'options.spares and options.maximum must be numbers')
+        self.p_spares = spares
+        self.p_max = maximum
+
+        self.p_checker = options.get('checker')
+        self.p_check_timeout = options.get('checkTimeout')
+
+        self.p_keys: list[str] = []
+        self.p_backends: dict[str, dict] = {}
+        self.p_connections: dict[str, list[ConnectionSlotFSM]] = {}
+        self.p_dead: dict[str, bool] = {}
+        self.p_lastrate: dict[str, dict] = {}
+
+        max_churn = options.get('maxChurnRate')
+        self.p_maxrate = max_churn if max_churn is not None else math.inf
+
+        self.p_last_rebalance = None
+        self.p_in_rebalance = False
+        self.p_rebal_scheduled = False
+        self.p_started_resolver = False
+        self.p_lpf = FIRFilter(LP_TAPS)
+
+        self.p_idleq = Queue()
+        self.p_initq = Queue()
+        self.p_waiters = Queue()
+
+        self.p_codel = None
+        tcd = options.get('targetClaimDelay')
+        if isinstance(tcd, (int, float)) and math.isfinite(tcd):
+            self.p_codel = mod_codel.ControlledDelay(tcd)
+
+        self.p_last_error = None
+        self.p_counters: dict[str, int] = {}
+
+        if options.get('resolver') is not None:
+            self.p_resolver = options['resolver']
+            self.p_resolver_custom = True
+        else:
+            from .resolver import Resolver
+            self.p_resolver = Resolver({
+                'resolvers': options.get('resolvers'),
+                'domain': domain,
+                'service': options.get('service'),
+                'maxDNSConcurrency': options.get('maxDNSConcurrency'),
+                'defaultPort': options.get('defaultPort'),
+                'log': self.p_log,
+                'recovery': recovery,
+            })
+            self.p_resolver_custom = False
+
+        # Periodic rebalance sweep: busy->idle returns are handled lazily
+        # (reference lib/pool.js:224-232).
+        self.p_rebal_timer = EventEmitter()
+        self.p_rebal_timer_inst = _Interval(10000, self.p_rebal_timer)
+
+        # Decoherence shuffle, clamped to >= 60s
+        # (reference lib/pool.js:234-245).
+        shuffle_intvl = options.get('decoherenceInterval')
+        if shuffle_intvl is None or shuffle_intvl < 60:
+            shuffle_intvl = 60
+        self.p_shuffle_timer = EventEmitter()
+        self.p_shuffle_timer_inst = _Interval(
+            shuffle_intvl * 1000, self.p_shuffle_timer)
+
+        self.p_last_rebal_clamped = False
+        self.p_rate_delay_timer = None
+
+        # Low-pass filter sampling at 5 Hz
+        # (reference lib/pool.js:249-262).
+        self.p_lp_emitter = EventEmitter()
+        self.p_lp_emitter.on('timeout', self._lp_sample)
+        self.p_lp_timer = _Interval(LP_INT, self.p_lp_emitter)
+
+        super().__init__('starting')
+
+    # -- internals -------------------------------------------------------
+
+    def _lp_sample(self) -> None:
+        conns = sum(len(v) for v in self.p_connections.values())
+        spares = len(self.p_idleq) + len(self.p_initq)
+        busy = conns - spares
+        self.p_lpf.put(busy + self.p_spares)
+        if self.p_last_rebal_clamped:
+            self.rebalance()
+
+    def _incr_counter(self, counter: str) -> None:
+        mod_utils.update_error_metrics(
+            self.p_collector, self.p_uuid, counter)
+        self.p_counters[counter] = self.p_counters.get(counter, 0) + 1
+
+    _incrCounter = _incr_counter
+
+    def _hwm_counter(self, counter: str, val: int) -> None:
+        if self.p_counters.get(counter, -math.inf) < val:
+            self.p_counters[counter] = val
+
+    def on_resolver_added(self, k: str, backend: dict) -> None:
+        """Insert at a random position in the preference list
+        (reference lib/pool.js:285-291; randomized per-client so load
+        spreads across the fleet, docs/internals.adoc:275-386)."""
+        import random
+        backend['key'] = k
+        idx = random.randrange(len(self.p_keys) + 1)
+        self.p_keys.insert(idx, k)
+        self.p_backends[k] = backend
+        self.rebalance()
+
+    def on_resolver_removed(self, k: str) -> None:
+        assert k in self.p_keys, 'resolver key %s not found' % k
+        self.p_keys.remove(k)
+        self.p_backends.pop(k, None)
+        self.p_dead.pop(k, None)
+        # Slot cleanup happens in the slot stateChanged handler once the
+        # FSMs come to rest (reference lib/pool.js:293-313).
+        for fsm in list(self.p_connections.get(k) or []):
+            fsm.set_unwanted()
+
+    # -- states ----------------------------------------------------------
+
+    def state_starting(self, S):
+        S.validTransitions(['failed', 'running', 'stopping'])
+        from .monitor import pool_monitor
+        pool_monitor.register_pool(self)
+
+        S.on(self.p_resolver, 'added', self.on_resolver_added)
+        S.on(self.p_resolver, 'removed', self.on_resolver_removed)
+
+        if self.p_resolver.is_in_state('failed'):
+            self.p_log.warning(
+                'pre-provided resolver has already failed, pool will '
+                'start up in "failed" state')
+            self.p_last_error = mod_errors.CueBallError(
+                'Pool resolver entered state "failed"',
+                self.p_resolver.get_last_error())
+            S.gotoState('failed')
+            return
+
+        def on_res_changed(state):
+            if state == 'failed':
+                self.p_log.warning('underlying resolver failed, moving '
+                                   'pool to "failed" state')
+                self.p_last_error = mod_errors.CueBallError(
+                    'Pool resolver entered state "failed"',
+                    self.p_resolver.get_last_error())
+                S.gotoState('failed')
+        S.on(self.p_resolver, 'stateChanged', on_res_changed)
+
+        if self.p_resolver.is_in_state('running'):
+            for k, backend in self.p_resolver.list().items():
+                self.on_resolver_added(k, backend)
+        elif self.p_resolver.is_in_state('stopped') and \
+                not self.p_resolver_custom:
+            self.p_resolver.start()
+            self.p_started_resolver = True
+
+        S.on(self, 'connectedToBackend', lambda *a: S.gotoState('running'))
+
+        def on_closed_backend(*a):
+            dead = len(self.p_dead)
+            self._hwm_counter('max-dead-backends', dead)
+            if dead >= len(self.p_keys):
+                self.p_log.warning(
+                    'pool has exhausted all retries, now moving to '
+                    '"failed" state (%d dead)', dead)
+                S.gotoState('failed')
+        S.on(self, 'closedBackend', on_closed_backend)
+
+        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+
+    def state_failed(self, S):
+        S.validTransitions(['running', 'stopping'])
+        S.on(self.p_resolver, 'added', self.on_resolver_added)
+        S.on(self.p_resolver, 'removed', self.on_resolver_removed)
+        S.on(self.p_shuffle_timer, 'timeout', self.reshuffle)
+
+        def on_connected(*a):
+            assert not self.p_resolver.is_in_state('failed')
+            self.p_log.info('successfully connected to a backend, '
+                            'moving back to running state')
+            S.gotoState('running')
+        S.on(self, 'connectedToBackend', on_connected)
+
+        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+
+        self._incr_counter('failed-state')
+
+        # Fail all outstanding waiting claims
+        # (reference lib/pool.js:398-406).
+        while not self.p_waiters.is_empty():
+            hdl = self.p_waiters.shift()
+            if hdl.is_in_state('waiting'):
+                hdl.fail(mod_errors.PoolFailedError(
+                    self, self.p_last_error))
+
+    def state_running(self, S):
+        S.validTransitions(['failed', 'stopping'])
+        S.on(self.p_resolver, 'added', self.on_resolver_added)
+        S.on(self.p_resolver, 'removed', self.on_resolver_removed)
+        S.on(self.p_rebal_timer, 'timeout', self.rebalance)
+        S.on(self.p_shuffle_timer, 'timeout', self.reshuffle)
+
+        def on_closed_backend(*a):
+            dead = len(self.p_dead)
+            self._hwm_counter('max-dead-backends', dead)
+            if dead >= len(self.p_keys):
+                self.p_log.warning(
+                    'pool has exhausted all retries, now moving to '
+                    '"failed" state (%d dead)', dead)
+                S.gotoState('failed')
+        S.on(self, 'closedBackend', on_closed_backend)
+
+        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+
+    def state_stopping(self, S):
+        S.validTransitions(['stopping.backends'])
+        if self.p_started_resolver:
+            def on_res_changed(s):
+                if s == 'stopped':
+                    S.gotoState('stopping.backends')
+            S.on(self.p_resolver, 'stateChanged', on_res_changed)
+            self.p_resolver.stop()
+            if self.p_resolver.is_in_state('stopped'):
+                S.gotoState('stopping.backends')
+        else:
+            S.gotoState('stopping.backends')
+
+    def state_stopping_backends(self, S):
+        S.validTransitions(['stopped'])
+        fsms = [fsm for conns in self.p_connections.values()
+                for fsm in conns]
+        remaining = {'n': len(fsms)}
+
+        def done_one():
+            remaining['n'] -= 1
+            if remaining['n'] == 0:
+                S.gotoState('stopped')
+
+        if not fsms:
+            S.immediate(lambda: S.gotoState('stopped'))
+            return
+
+        for fsm in fsms:
+            fsm.set_unwanted()
+            if fsm.is_in_state('stopped') or fsm.is_in_state('failed'):
+                done_one()
+            else:
+                def on_changed(st, _fsm=fsm):
+                    if st in ('stopped', 'failed'):
+                        done_one()
+                S.on(fsm, 'stateChanged', on_changed)
+
+    def state_stopped(self, S):
+        S.validTransitions([])
+        from .monitor import pool_monitor
+        pool_monitor.unregister_pool(self)
+        self.p_keys = []
+        self.p_connections = {}
+        self.p_backends = {}
+        self.p_rebal_timer_inst.cancel()
+        self.p_shuffle_timer_inst.cancel()
+        self.p_lp_timer.cancel()
+        if self.p_rate_delay_timer is not None:
+            self.p_rate_delay_timer.cancel()
+
+    # -- public helpers --------------------------------------------------
+
+    def should_retry_backend(self, backend: str) -> bool:
+        return backend in self.p_backends
+
+    def is_declared_dead(self, backend: str) -> bool:
+        return self.p_dead.get(backend) is True
+
+    isDeclaredDead = is_declared_dead
+
+    def get_last_error(self):
+        return self.p_last_error
+
+    getLastError = get_last_error
+
+    def reshuffle(self) -> None:
+        """Decoherence shuffle: move a random preference entry so
+        per-client orderings decorrelate over time
+        (reference lib/pool.js:501-519)."""
+        import random
+        if len(self.p_keys) <= 1:
+            return
+        taken = self.p_keys.pop()
+        idx = random.randrange(len(self.p_keys) + 1)
+        conns = sum(len(v) for v in self.p_connections.values())
+        if len(self.p_keys) > conns and idx < conns:
+            self.p_log.info('random shuffle puts backend "%s" at idx %d',
+                            taken, idx)
+        self.p_keys.insert(idx, taken)
+        self.rebalance()
+
+    def stop(self) -> None:
+        self.emit('stopAsserted')
+
+    # -- rebalancing -----------------------------------------------------
+
+    def rebalance(self, *_a) -> None:
+        if len(self.p_keys) < 1:
+            return
+        if self.is_in_state('stopping') or self.is_in_state('stopped'):
+            return
+        if self.p_rebal_scheduled is not False:
+            return
+        self.p_rebal_scheduled = True
+        get_loop().call_soon(self._rebalance)
+
+    def _rebalance(self) -> None:
+        """Compute and apply a plan toward even distribution
+        (reference lib/pool.js:544-666)."""
+        if self.p_in_rebalance is not False:
+            return
+        self.p_in_rebalance = True
+        self.p_rebal_scheduled = False
+
+        total = 0
+        conns: dict[str, list] = {}
+        for k in self.p_keys:
+            conns[k] = list(self.p_connections.get(k) or [])
+            total += len(conns[k])
+        spares = len(self.p_idleq) + len(self.p_initq) - \
+            len(self.p_waiters)
+        if spares < 0:
+            spares = 0
+        busy = total - spares
+        if busy < 0:
+            busy = 0
+        extras = len(self.p_waiters) - len(self.p_initq)
+        if extras < 0:
+            extras = 0
+
+        target = busy + extras + self.p_spares
+
+        # Clamp shrinking against the low-pass-filtered recent load
+        # (reference lib/pool.js:577-592).
+        min_ = math.ceil(self.p_lpf.get())
+        if target < min_ * 1.05:
+            target = min_
+            self.p_last_rebal_clamped = True
+        else:
+            self.p_last_rebal_clamped = False
+
+        if target > self.p_max:
+            target = self.p_max
+
+        plan = mod_utils.plan_rebalance(
+            conns, self.p_dead, target, self.p_max)
+
+        if plan['remove'] or plan['add']:
+            self.p_log.debug(
+                'rebalancing pool, remove %d, add %d (busy = %d, '
+                'spares = %d, target = %d)', len(plan['remove']),
+                len(plan['add']), busy, spares, target)
+
+        now = time.time()
+        rate_delay = None
+
+        for fsm in plan['remove']:
+            k = fsm.get_backend()['key']
+            lastrate = self.p_lastrate.get(k)
+            n = len(self.p_connections.get(k) or []) - 1
+            if lastrate:
+                tdelta = now - lastrate['time']
+                ndelta = n - lastrate['count']
+                rate = abs(ndelta / tdelta) if tdelta else math.inf
+                if rate > self.p_maxrate:
+                    tnext = lastrate['time'] + \
+                        abs(ndelta) / self.p_maxrate
+                    delay = tnext - now
+                    if rate_delay is None or delay < rate_delay:
+                        rate_delay = delay
+                    continue
+            self.p_lastrate[k] = {'time': now, 'count': n}
+
+            fsm.set_unwanted()
+            # If it stopped synchronously, don't count it against the cap
+            # (reference lib/pool.js:646-653).
+            if fsm.is_in_state('stopped') or fsm.is_in_state('failed'):
+                total -= 1
+
+        for k in plan['add']:
+            lastrate = self.p_lastrate.get(k)
+            n = len(self.p_connections.get(k) or []) + 1
+            if lastrate:
+                tdelta = now - lastrate['time']
+                ndelta = n - lastrate['count']
+                rate = abs(ndelta / tdelta) if tdelta else math.inf
+                if rate > self.p_maxrate:
+                    tnext = lastrate['time'] + \
+                        abs(ndelta) / self.p_maxrate
+                    delay = tnext - now
+                    if rate_delay is None or delay < rate_delay:
+                        rate_delay = delay
+                    continue
+            self.p_lastrate[k] = {'time': now, 'count': n}
+            total += 1
+            if total > self.p_max:
+                # Never exceed the socket cap.
+                continue
+            self.add_connection(k)
+
+        if rate_delay is not None:
+            if self.p_rate_delay_timer is not None:
+                self.p_rate_delay_timer.cancel()
+            self.p_rate_delay_timer = get_loop().call_later(
+                (rate_delay * 1000 + 10) / 1000.0, self.rebalance)
+
+        self.p_in_rebalance = False
+        self.p_last_rebalance = time.time()
+
+    def add_connection(self, key: str) -> None:
+        """Create a slot for `key` and wire the pool's slot stateChanged
+        orchestration (reference lib/pool.js:668-810)."""
+        if self.is_in_state('stopping') or self.is_in_state('stopped'):
+            return
+
+        backend = self.p_backends[key]
+        backend['key'] = key
+
+        fsm = ConnectionSlotFSM({
+            'constructor': self.p_constructor,
+            'backend': backend,
+            'log': self.p_log,
+            'pool': self,
+            'checker': self.p_checker,
+            'checkTimeout': self.p_check_timeout,
+            'recovery': self.p_recovery,
+            'monitor': self.p_dead.get(key) is True,
+        })
+        self.p_connections.setdefault(key, []).append(fsm)
+
+        fsm.p_initq_node = self.p_initq.push(fsm)
+        fsm.p_idleq_node = None
+
+        def on_changed(new_state):
+            if fsm.p_initq_node:
+                # Still starting up during these transitions.
+                if new_state in ('init', 'connecting', 'retrying'):
+                    return
+                fsm.p_initq_node.remove()
+                fsm.p_initq_node = None
+
+            if new_state == 'idle':
+                self.emit('connectedToBackend', key, fsm)
+                if key in self.p_dead:
+                    del self.p_dead[key]
+                    self.rebalance()
+
+            if new_state == 'idle' and fsm.is_in_state('idle'):
+                # Slot became available: hand to a waiter or queue idle.
+                if key not in self.p_backends:
+                    fsm.set_unwanted()
+                    return
+
+                while len(self.p_waiters) > 0:
+                    hdl = self.p_waiters.shift()
+                    drop = self.p_codel is not None and \
+                        self.p_codel.overloaded(hdl.ch_started)
+                    if not hdl.is_in_state('waiting'):
+                        continue
+                    if drop:
+                        hdl.timeout()
+                        continue
+                    hdl.try_(fsm)
+                    return
+
+                if self.p_codel is not None:
+                    self.p_codel.empty()
+
+                fsm.p_idleq_node = self.p_idleq.push(fsm)
+                return
+
+            # Health-check claims sit on the initq so they don't count
+            # as busy (reference lib/pool.js:762-768).
+            if new_state == 'busy' and fsm.is_running_ping() and \
+                    not fsm.p_initq_node:
+                fsm.p_initq_node = self.p_initq.push(fsm)
+
+            if new_state == 'failed':
+                # No dead mark if the backend has been removed
+                # (regression #144, reference lib/pool.js:771-777).
+                if key in self.p_backends:
+                    self.p_dead[key] = True
+                err = fsm.get_socket_mgr().get_last_error()
+                if err is not None:
+                    self.p_last_error = err
+
+            if new_state in ('stopped', 'failed'):
+                lst = self.p_connections.get(key)
+                if lst:
+                    assert fsm in lst
+                    lst.remove(fsm)
+                    if not lst:
+                        del self.p_connections[key]
+                self.emit('closedBackend', key, fsm)
+                self.rebalance()
+
+            if fsm.p_idleq_node:
+                # Was idle, now isn't: off the idle queue.
+                fsm.p_idleq_node.remove()
+                fsm.p_idleq_node = None
+                self.rebalance()
+
+        fsm.on('stateChanged', on_changed)
+        fsm.start()
+
+    addConnection = add_connection
+
+    # -- stats -----------------------------------------------------------
+
+    def get_stats(self) -> dict:
+        """Counter snapshot + queue gauges (reference lib/pool.js:834-857,
+        added for #132)."""
+        tconns = sum(len(v) for v in self.p_connections.values())
+        return {
+            'counters': dict(self.p_counters),
+            'totalConnections': tconns,
+            'idleConnections': len(self.p_idleq),
+            'pendingConnections': len(self.p_initq),
+            'waiterCount': len(self.p_waiters),
+        }
+
+    getStats = get_stats
+
+    # -- claim -----------------------------------------------------------
+
+    def claim_cb(self, options=None, cb=None):
+        """Callback-style claim (reference lib/pool.js:859-969). Returns
+        the ClaimHandle (or a cancel-shim for early failures). ``cb`` is
+        called with (err) or (None, handle, connection)."""
+        if callable(options) and cb is None:
+            cb = options
+            options = {}
+        options = options or {}
+        if not callable(cb):
+            raise AssertionError('cb must be callable')
+        err_on_empty = options.get('errorOnEmpty')
+
+        if self.p_codel is not None:
+            if isinstance(options.get('timeout'), (int, float)):
+                raise RuntimeError('options.timeout not allowed when '
+                                   'targetClaimDelay has been set')
+            timeout = self.p_codel.get_max_idle()
+        elif isinstance(options.get('timeout'), (int, float)):
+            timeout = options['timeout']
+        else:
+            timeout = math.inf
+
+        self._incr_counter('claim')
+
+        state = {'done': False}
+        if self.is_in_state('stopping') or self.is_in_state('stopped'):
+            def fail_stopping():
+                if not state['done']:
+                    cb(mod_errors.PoolStoppingError(self))
+                state['done'] = True
+            get_loop().call_soon(fail_stopping)
+            return _CancelShim(state)
+        if self.is_in_state('failed'):
+            def fail_failed():
+                if not state['done']:
+                    cb(mod_errors.PoolFailedError(
+                        self, self.p_last_error))
+                state['done'] = True
+            get_loop().call_soon(fail_failed)
+            return _CancelShim(state)
+
+        e = mod_utils.maybe_capture_stack_trace()
+
+        handle = CueBallClaimHandle({
+            'pool': self,
+            'claimStack': e['stack'],
+            'callback': cb,
+            'log': self.p_log,
+            'claimTimeout': timeout,
+        })
+
+        def try_next():
+            if not handle.is_in_state('waiting'):
+                return
+
+            # Take an idle connection if one is truly idle. Entries may
+            # be stale (stateChanged is emitted async); rip them off and
+            # move on (reference lib/pool.js:929-951).
+            while len(self.p_idleq) > 0:
+                fsm = self.p_idleq.shift()
+                fsm.p_idleq_node = None
+                if not fsm.is_in_state('idle'):
+                    continue
+                handle.try_(fsm)
+                return
+
+            if err_on_empty and self.p_resolver.count() < 1:
+                handle.fail(mod_errors.NoBackendsError(
+                    self, self.p_resolver.get_last_error()))
+
+            self.p_waiters.push(handle)
+            self._hwm_counter('max-claim-queue', len(self.p_waiters))
+            self._incr_counter('queued-claim')
+            self.rebalance()
+
+        def waiting_listener(st):
+            if st == 'waiting':
+                try_next()
+        handle.on('stateChanged', waiting_listener)
+
+        return handle
+
+    async def claim(self, options: dict | None = None):
+        """Asyncio-native claim: returns (handle, connection); raises the
+        claim error otherwise. Cancelling the awaiting task cancels the
+        claim (so the callback contract of the reference's
+        waiter.cancel() maps onto task cancellation)."""
+        import asyncio
+        loop = get_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def cb(err, hdl=None, conn=None):
+            if fut.cancelled():
+                if hdl is not None:
+                    hdl.release()
+                return
+            if err is not None:
+                fut.set_exception(err)
+            else:
+                fut.set_result((hdl, conn))
+
+        waiter = self.claim_cb(options, cb)
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            waiter.cancel()
+            raise
+
+
+class _CancelShim:
+    """Stands in for a handle when claim() fails fast
+    (reference lib/pool.js:889-910)."""
+
+    def __init__(self, state):
+        self._state = state
+
+    def cancel(self):
+        self._state['done'] = True

@@ -36,3 +36,14 @@ async def settle(n=10):
 
 async def wait_ms(ms):
     await asyncio.sleep(ms / 1000.0)
+
+
+async def wait_for_state(fsm, state, timeout=5.0):
+    """Poll until fsm enters `state` (tape's wait-for-stateChanged style)."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not fsm.is_in_state(state):
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError(
+                'timed out waiting for state %r (in %r)' % (
+                    state, fsm.get_state()))
+        await asyncio.sleep(0.01)

@@ -1,0 +1,61 @@
+"""Shared test fakes, mirroring the reference suite's fixtures:
+DummyConnection with manually-driven connect/error/close
+(reference test/pool.test.js:69-98) and a minimal pool stand-in."""
+
+from cueball_tpu.events import EventEmitter
+
+
+class DummyConnection(EventEmitter):
+    """Connection-interface object whose lifecycle is driven by the test:
+    nothing happens until the test calls connect()/emit."""
+
+    instances = []
+
+    def __init__(self, backend):
+        super().__init__()
+        self.backend = backend
+        self.refd = True
+        self.connected = False
+        self.dead = False
+        DummyConnection.instances.append(self)
+
+    def connect(self):
+        assert self.dead is False
+        self.connected = True
+        self.emit('connect')
+
+    def unref(self):
+        self.refd = False
+
+    def ref(self):
+        self.refd = True
+
+    def destroy(self):
+        self.dead = True
+        self.connected = False
+
+
+class FakePool:
+    """Just enough of the pool surface for slot-stack unit tests."""
+
+    def __init__(self):
+        self.p_uuid = '12345678-dead-beef-cafe-000000000000'
+        self.p_domain = 'fake.example.com'
+        self.p_dead = {}
+        self.p_keys = []
+        self.counters = {}
+
+    def _incr_counter(self, name):
+        self.counters[name] = self.counters.get(name, 0) + 1
+
+    _incrCounter = _incr_counter
+
+
+def backend(key='b1', address='1.2.3.1', port=80):
+    return {'key': key, 'name': key, 'address': address, 'port': port}
+
+
+def recovery(retries=3, timeout=100, delay=10, **kw):
+    r = {'retries': retries, 'timeout': timeout, 'delay': delay}
+    r.update(kw)
+    return {'default': r}

@@ -1,0 +1,365 @@
+"""Slot-stack tests: SocketMgrFSM + ConnectionSlotFSM + CueBallClaimHandle
+driven with DummyConnections (reference test/pool.test.js fixture style;
+behaviors per lib/connection-fsm.js)."""
+
+import asyncio
+import math
+
+import pytest
+
+from cueball_tpu import errors as mod_errors
+from cueball_tpu.connection_fsm import (
+    ConnectionSlotFSM, CueBallClaimHandle, count_listeners)
+
+from conftest import run_async, settle
+from fakes import DummyConnection, FakePool, backend, recovery
+
+
+def make_slot(pool, monitor=False, recov=None, constructor=None, **opts):
+    DummyConnection.instances = []
+    return ConnectionSlotFSM({
+        'pool': pool,
+        'constructor': constructor or DummyConnection,
+        'backend': backend(),
+        'recovery': recov or recovery(),
+        'monitor': monitor,
+        **opts,
+    })
+
+
+def make_handle(pool, cb, timeout=math.inf):
+    return CueBallClaimHandle({
+        'pool': pool,
+        'claimTimeout': timeout,
+        'claimStack': 'Error\nat test\nat test\n',
+        'callback': cb,
+    })
+
+
+def test_slot_connects_to_idle():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        assert slot.is_in_state('connecting')
+        assert len(DummyConnection.instances) == 1
+        DummyConnection.instances[0].connect()
+        await settle()
+        assert slot.is_in_state('idle')
+        assert slot.get_socket_mgr().is_in_state('connected')
+    run_async(t())
+
+
+def test_claim_handshake_and_release():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        DummyConnection.instances[0].connect()
+        await settle()
+
+        got = []
+        hdl = make_handle(pool, lambda err, h=None, c=None:
+                          got.append((err, h, c)))
+        hdl.try_(slot)
+        await settle()
+        assert slot.is_in_state('busy')
+        assert hdl.is_in_state('claimed')
+        assert len(got) == 1
+        err, h, conn = got[0]
+        assert err is None
+        assert h is hdl
+        assert conn is DummyConnection.instances[0]
+
+        hdl.release()
+        await settle()
+        assert slot.is_in_state('idle')
+        assert hdl.is_in_state('released')
+
+        # Reclaim works.
+        got2 = []
+        hdl2 = make_handle(pool, lambda err, h=None, c=None:
+                           got2.append((err, h, c)))
+        hdl2.try_(slot)
+        await settle()
+        assert got2 and got2[0][0] is None
+    run_async(t())
+
+
+def test_double_release_raises():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        DummyConnection.instances[0].connect()
+        await settle()
+        hdl = make_handle(pool, lambda *a: None)
+        hdl.try_(slot)
+        await settle()
+        hdl.release()
+        with pytest.raises(RuntimeError, match='not claimed'):
+            hdl.release()
+    run_async(t())
+
+
+def test_close_kills_connection():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        conn = DummyConnection.instances[0]
+        conn.connect()
+        await settle()
+        hdl = make_handle(pool, lambda *a: None)
+        hdl.try_(slot)
+        await settle()
+        hdl.close()
+        await settle()
+        assert conn.dead
+        # killing -> smgr closed -> retrying -> backoff delay -> reconnect
+        await asyncio.sleep(0.05)
+        assert len(DummyConnection.instances) == 2
+    run_async(t())
+
+
+def test_error_while_claimed_goes_retrying():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        conn = DummyConnection.instances[0]
+        conn.connect()
+        await settle()
+        hdl = make_handle(pool, lambda *a: None)
+        hdl.try_(slot)
+        await settle()
+        # User listens for errors, so no raise; slot should cycle.
+        conn.on('error', lambda e: None)
+        conn.emit('error', ValueError('boom'))
+        await settle()
+        hdl.release()
+        await settle()
+        assert slot.is_in_state('retrying') or \
+            slot.is_in_state('connecting')
+        assert pool.counters.get('error-while-connected') == 1
+    run_async(t())
+
+
+def test_claim_vs_disconnect_race_rejects():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        conn = DummyConnection.instances[0]
+        conn.connect()
+        await settle()
+        assert slot.is_in_state('idle')
+
+        # Connection dies and a claim lands in the same loop turn,
+        # before the slot observes the smgr transition.
+        conn.emit('error', ValueError('dead'))
+        calls = []
+        hdl = make_handle(pool, lambda err, h=None, c=None:
+                          calls.append(err))
+        hdl.try_(slot)
+        await settle()
+        # The double-handshake must bounce the handle back to waiting,
+        # not hand out a dead socket (docs/internals.adoc:454-477).
+        assert hdl.is_in_state('waiting')
+        assert calls == []
+    run_async(t())
+
+
+def test_connect_failure_retries_then_failed():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool, recov=recovery(retries=2, timeout=50,
+                                              delay=5))
+        slot.start()
+        await settle()
+        # Fail every connect attempt.
+        for _ in range(4):
+            assert DummyConnection.instances, 'expected a connect attempt'
+            DummyConnection.instances[-1].emit('error', ValueError('nope'))
+            await asyncio.sleep(0.03)
+        assert slot.is_in_state('failed')
+        # retries=2 means 2 attempts total.
+        assert len(DummyConnection.instances) == 2
+        assert pool.counters.get('retries-exhausted') == 1
+    run_async(t())
+
+
+def test_connect_timeout_counts():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool, recov=recovery(retries=2, timeout=30,
+                                              delay=5))
+        slot.start()
+        await asyncio.sleep(0.2)  # let both attempts time out
+        assert slot.is_in_state('failed')
+        assert pool.counters.get('timeout-during-connect') == 2
+    run_async(t())
+
+
+def test_monitor_mode_retries_forever_and_converts():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool, monitor=True,
+                         recov=recovery(retries=2, timeout=30, delay=5,
+                                        maxDelay=10, maxTimeout=60))
+        slot.start()
+        await settle()
+        smgr = slot.get_socket_mgr()
+        assert smgr.sm_retries_left == math.inf
+        # Fail several attempts: monitor never reaches 'failed'.
+        for _ in range(4):
+            DummyConnection.instances[-1].emit('error', ValueError('x'))
+            await asyncio.sleep(0.03)
+        assert not slot.is_in_state('failed')
+        # Now let it connect: slot converts monitor -> normal.
+        DummyConnection.instances[-1].connect()
+        await settle()
+        assert slot.is_in_state('idle')
+        assert slot.csf_monitor is False
+        assert smgr.sm_retries_left != math.inf
+    run_async(t())
+
+
+def test_set_unwanted_idle_stops_cleanly():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        conn = DummyConnection.instances[0]
+        conn.connect()
+        await settle()
+        slot.set_unwanted()
+        await settle()
+        assert slot.is_in_state('stopped')
+        assert conn.dead
+    run_async(t())
+
+
+def test_unwanted_while_busy_stops_after_release():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        DummyConnection.instances[0].connect()
+        await settle()
+        hdl = make_handle(pool, lambda *a: None)
+        hdl.try_(slot)
+        await settle()
+        slot.set_unwanted()
+        await settle()
+        assert slot.is_in_state('busy')  # claim is honored to completion
+        hdl.release()
+        await settle()
+        assert slot.is_in_state('stopped')
+    run_async(t())
+
+
+def test_claim_timeout_fails_handle():
+    async def t():
+        pool = FakePool()
+        calls = []
+        hdl = make_handle(pool, lambda err, h=None, c=None:
+                          calls.append(err), timeout=30)
+        await asyncio.sleep(0.08)
+        assert hdl.is_in_state('failed')
+        assert len(calls) == 1
+        assert isinstance(calls[0], mod_errors.ClaimTimeoutError)
+        assert pool.counters.get('claim-timeout') == 1
+    run_async(t())
+
+
+def test_cancel_waiting_never_calls_back():
+    async def t():
+        pool = FakePool()
+        calls = []
+        hdl = make_handle(pool, lambda *a: calls.append(a))
+        hdl.cancel()
+        await asyncio.sleep(0.05)
+        assert hdl.is_in_state('cancelled')
+        assert calls == []
+    run_async(t())
+
+
+def test_cancel_after_claim_releases():
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        DummyConnection.instances[0].connect()
+        await settle()
+        hdl = make_handle(pool, lambda *a: None)
+        hdl.try_(slot)
+        await settle()
+        assert hdl.is_in_state('claimed')
+        hdl.cancel()
+        await settle()
+        assert hdl.is_in_state('released')
+        assert slot.is_in_state('idle')
+    run_async(t())
+
+
+def test_handle_misuse_traps():
+    async def t():
+        pool = FakePool()
+        hdl = make_handle(pool, lambda *a: None)
+        with pytest.raises(mod_errors.ClaimHandleMisusedError):
+            hdl.readable
+        with pytest.raises(mod_errors.ClaimHandleMisusedError):
+            hdl.writable
+        with pytest.raises(mod_errors.ClaimHandleMisusedError):
+            hdl.write(b'x')
+        with pytest.raises(mod_errors.ClaimHandleMisusedError):
+            hdl.on('readable', lambda: None)
+        with pytest.raises(mod_errors.ClaimHandleMisusedError):
+            hdl.once('close', lambda: None)
+        hdl.cancel()
+    run_async(t())
+
+
+def test_count_listeners_ignores_internal():
+    async def t():
+        conn = DummyConnection(backend())
+        assert count_listeners(conn, 'error') == 0
+        conn.on('error', lambda e: None)
+        assert count_listeners(conn, 'error') == 1
+
+        def internal(e):
+            pass
+        internal._cueball_internal = True
+        conn.on('error', internal)
+        assert count_listeners(conn, 'error') == 1
+    run_async(t())
+
+
+def test_ping_checker_runs_on_idle_timeout():
+    async def t():
+        pool = FakePool()
+        checked = []
+
+        def checker(hdl, conn):
+            checked.append(conn)
+            hdl.release()
+
+        slot = make_slot(pool, checker=checker, checkTimeout=30)
+        slot.start()
+        await settle()
+        DummyConnection.instances[0].connect()
+        await settle()
+        await asyncio.sleep(0.1)
+        assert len(checked) >= 2  # keeps re-arming while idle
+        assert slot.is_in_state('idle')
+    run_async(t())

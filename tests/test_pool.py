@@ -1,0 +1,544 @@
+"""ConnectionPool tests, ported from reference test/pool.test.js:
+lifecycle against fakes, claim ladder, expansion, close-while-idle (no
+backoff), dead/monitor handling, failed-state short circuit + recovery,
+regression races #108/#111/#144, getStats #132, claim cancel, churn."""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu import errors as mod_errors
+from cueball_tpu.events import EventEmitter
+from cueball_tpu.pool import ConnectionPool
+from cueball_tpu.resolver import ResolverFSM
+
+from conftest import run_async, settle, wait_for_state
+
+
+class Ctx:
+    """Per-test fixture state (the reference's module globals)."""
+
+    def __init__(self):
+        self.connections = []
+
+    def summarize(self):
+        index, counts = {}, {}
+        for c in self.connections:
+            index.setdefault(c.backend, []).append(c)
+            counts[c.backend] = counts.get(c.backend, 0) + 1
+        return index, counts
+
+
+class DummyConnection(EventEmitter):
+    def __init__(self, ctx, backend):
+        super().__init__()
+        ctx.connections.append(self)
+        self._ctx = ctx
+        self.backend = backend['key']
+        self.backend_info = backend
+        self.refd = True
+        self.connected = False
+        self.dead = False
+        self.checked = False
+
+    def connect(self):
+        assert self.dead is False
+        assert self.connected is False
+        self.connected = True
+        self.emit('connect')
+
+    def unref(self):
+        self.refd = False
+
+    def ref(self):
+        self.refd = True
+
+    def destroy(self):
+        if self in self._ctx.connections:
+            self._ctx.connections.remove(self)
+        self.connected = False
+        self.dead = True
+
+
+class DummyInner(EventEmitter):
+    """Reference DummyResolver (test/pool.test.js:44-67): inner resolver
+    driven by the test emitting added/removed directly."""
+
+    def __init__(self):
+        super().__init__()
+        self.state = 'stopped'
+        self.backends = {}
+        self.on('added', lambda k, b: self.backends.__setitem__(k, b))
+        self.on('removed', lambda k: self.backends.pop(k, None))
+
+    def start(self):
+        self.state = 'running'
+        self.emit('updated')
+
+    def stop(self):
+        self.state = 'stopped'
+
+    def count(self):
+        return len(self.backends)
+
+    def list(self):
+        return dict(self.backends)
+
+
+def make_pool(ctx, spares=2, maximum=2, retries=1, timeout=500, delay=0,
+              **opts):
+    inner = DummyInner()
+    resolver = ResolverFSM(inner, {})
+    resolver.start()
+    pool = ConnectionPool({
+        'domain': 'foobar',
+        'spares': spares,
+        'maximum': maximum,
+        'constructor': lambda backend: DummyConnection(ctx, backend),
+        'recovery': {'default': {
+            'timeout': timeout, 'retries': retries, 'delay': delay}},
+        'resolver': resolver,
+        **opts,
+    })
+    return pool, inner
+
+
+def claim(pool, options=None):
+    """Callback claim -> (future, waiter-handle)."""
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+
+    def cb(err, hdl=None, conn=None):
+        if not fut.done():
+            if err is not None:
+                fut.set_exception(err)
+            else:
+                fut.set_result((hdl, conn))
+    waiter = pool.claim_cb(options or {}, cb)
+    return fut, waiter
+
+
+def test_empty_pool():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=4)
+        await settle()
+        assert ctx.connections == []
+
+        fut, _ = claim(pool, {'errorOnEmpty': True})
+        with pytest.raises(mod_errors.NoBackendsError):
+            await fut
+
+        fut2, _ = claim(pool, {'timeout': 100})
+        with pytest.raises(mod_errors.ClaimTimeoutError):
+            await fut2
+        pool.stop()
+        await settle()
+    run_async(t())
+
+
+def test_pool_with_one_backend():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        assert len(ctx.connections) == 2
+        assert all(c.backend == 'b1' for c in ctx.connections)
+
+        # Connections haven't connected yet: claim times out.
+        fut, _ = claim(pool, {'timeout': 100})
+        with pytest.raises(mod_errors.ClaimTimeoutError):
+            await fut
+
+        for c in list(ctx.connections):
+            assert c.refd is True
+            c.connect()
+        await settle()
+
+        fut1, _ = claim(pool, {'timeout': 100})
+        hdl1, conn1 = await fut1
+        assert conn1 in ctx.connections
+
+        fut2, _ = claim(pool, {'timeout': 100})
+        hdl2, conn2 = await fut2
+        assert conn2 in ctx.connections
+        assert conn2 is not conn1
+
+        # Both claimed: next claim times out.
+        fut3, _ = claim(pool, {'timeout': 100})
+        with pytest.raises(mod_errors.ClaimTimeoutError):
+            await fut3
+
+        hdl1.release()
+        hdl2.release()
+        pool.stop()
+        await settle(30)
+        assert pool.is_in_state('stopped')
+    run_async(t())
+
+
+def test_async_claim_expands_to_max():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=0, maximum=2)
+        inner.emit('added', 'b1', {})
+        inner.emit('added', 'b2', {})
+        await settle()
+        assert len(ctx.connections) == 0
+
+        def autoconnect():
+            for c in ctx.connections:
+                if not c.connected and not c.dead:
+                    c.connect()
+
+        fut1, _ = claim(pool)
+        await settle()
+        autoconnect()
+        hdl1, conn1 = await fut1
+        b1 = conn1.backend
+
+        fut2, _ = claim(pool)
+        await settle()
+        autoconnect()
+        hdl2, conn2 = await fut2
+        b2 = conn2.backend
+        assert {b1, b2} == {'b1', 'b2'}  # spread over backends
+
+        fut3, _ = claim(pool, {'timeout': 100})
+        with pytest.raises(mod_errors.ClaimTimeoutError):
+            await fut3
+
+        hdl1.release()
+        hdl2.release()
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_spares_balanced_evenly():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=4, maximum=8)
+        inner.emit('added', 'b1', {})
+        inner.emit('added', 'b2', {})
+        await settle()
+        _, counts = ctx.summarize()
+        assert counts == {'b1': 2, 'b2': 2}
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_close_while_idle_no_backoff():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=1)
+        inner.emit('added', 'b1', {})
+        await settle()
+        assert len(ctx.connections) == 1
+        conn = ctx.connections[0]
+        conn.connect()
+        await asyncio.sleep(0.1)
+
+        conn.emit('close')
+        await settle(30)
+        assert conn.dead
+        assert len(ctx.connections) == 1
+        assert ctx.connections[0] is not conn
+        assert not ctx.connections[0].dead
+        ctx.connections[0].connect()
+
+        # Clean closes must reconnect without entering backoff
+        # (reference test/pool.test.js:373-374 checks fsm history).
+        assert 'backoff' not in conn.sm_fsm.get_history()
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_removing_backend():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=3, timeout=100)
+        inner.emit('added', 'b1', {})
+        inner.emit('added', 'b2', {})
+        await settle()
+        assert len(ctx.connections) == 2
+        index, counts = ctx.summarize()
+        assert counts == {'b1': 1, 'b2': 1}
+        index['b1'][0].connect()
+        # Get b2 declared dead (retries=1: one error exhausts).
+        index['b2'][0].emit('error', Exception('x'))
+        await asyncio.sleep(0.1)
+        assert list(pool.p_dead.keys()) == ['b2']
+        assert pool.is_in_state('running')
+
+        # Remove the dead backend entirely: dead mark cleaned up and its
+        # monitor slots become unwanted. The in-flight monitor connect
+        # attempt lingers until its (doubled) timeout fires, then stops.
+        inner.emit('removed', 'b2')
+        await asyncio.sleep(0.05)
+        assert 'b2' not in pool.p_dead
+        assert pool.p_keys == ['b1']
+        await asyncio.sleep(0.4)
+        _, counts = ctx.summarize()
+        assert set(counts.keys()) == {'b1'}
+        assert 'b2' not in pool.p_connections
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_pool_failure_shortcircuit_and_recovery():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        assert len(ctx.connections) == 1
+        ctx.connections[0].connect()
+        await settle()
+        assert pool.is_in_state('running')
+
+        # Kill it; retries=1 means instant dead -> whole pool failed.
+        ctx.connections[0].emit('error', Exception('boom'))
+        await asyncio.sleep(0.05)
+        assert pool.is_in_state('failed')
+        assert pool.get_last_error() is not None
+
+        # Claims short-circuit with PoolFailedError (no timeout wait).
+        fut, _ = claim(pool)
+        with pytest.raises(mod_errors.PoolFailedError):
+            await fut
+
+        # The monitor probe eventually reconnects -> running again.
+        await asyncio.sleep(0.05)
+        mon = [c for c in ctx.connections if not c.connected]
+        assert mon, 'expected a monitor connection attempt'
+        mon[0].connect()
+        await settle(30)
+        assert pool.is_in_state('running')
+        assert pool.p_dead == {}
+
+        fut2, _ = claim(pool, {'timeout': 100})
+        hdl, conn = await fut2
+        hdl.release()
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_failed_claims_queued_fail_on_entering_failed():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=1)
+        inner.emit('added', 'b1', {})
+        await settle()
+        # Queue a claim while the conn never connects.
+        fut, _ = claim(pool)
+        await settle()
+        # Now exhaust the backend.
+        ctx.connections[0].emit('error', Exception('boom'))
+        with pytest.raises(mod_errors.PoolFailedError):
+            await asyncio.wait_for(fut, 2)
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_claim_cancellation():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        assert len(ctx.connections) == 2
+
+        called = []
+        waiter = pool.claim_cb({'timeout': 100},
+                               lambda *a: called.append(a))
+        await settle()
+        waiter.cancel()
+
+        # Connect afterwards: the cancelled claim must never fire.
+        for c in ctx.connections:
+            c.connect()
+        await asyncio.sleep(0.15)
+        assert called == []
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_cueball_108_close_after_claim_close_race():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2, retries=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        assert len(ctx.connections) == 2
+        for c in ctx.connections:
+            c.connect()
+        await asyncio.sleep(0.1)
+        assert pool.is_in_state('running')
+        assert len(ctx.connections) == 2
+
+        fut, _ = claim(pool)
+        hdl, conn = await fut
+        await asyncio.sleep(0.05)
+        # Close the handle and have the socket emit 'close' in the same
+        # turn: must not crash or wedge the slot (#108).
+        hdl.close()
+        conn.emit('close')
+        await asyncio.sleep(0.1)
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
+
+
+def test_cueball_111_error_after_close_race():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2, retries=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in ctx.connections:
+            c.connect()
+        await asyncio.sleep(0.1)
+        assert pool.is_in_state('running')
+
+        fut, _ = claim(pool)
+        hdl, conn = await fut
+        await asyncio.sleep(0.05)
+        # Error emitted right after handle close (#111).
+        hdl.close()
+        conn.emit('error', Exception('Foo'))
+        await asyncio.sleep(0.1)
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
+
+
+def test_cueball_132_get_stats():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2, retries=2)
+        s = pool.get_stats()
+        assert len(s) == 5
+        assert isinstance(s['counters'], dict)
+        assert s['totalConnections'] == 0
+        assert s['idleConnections'] == 0
+        assert s['pendingConnections'] == 0
+        assert s['waiterCount'] == 0
+
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in ctx.connections:
+            c.connect()
+        await asyncio.sleep(0.05)
+        assert pool.is_in_state('running')
+        s = pool.get_stats()
+        assert s['totalConnections'] == 2
+        assert s['idleConnections'] == 2
+        assert s['pendingConnections'] == 0
+        assert s['waiterCount'] == 0
+        pool.stop()
+        await settle(40)
+    run_async(t())
+
+
+def test_cueball_144_failure_removal_race():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2, retries=2,
+                                delay=0)
+        inner.emit('added', 'b1', {})
+        inner.emit('added', 'b2', {})
+        await settle()
+        index, counts = ctx.summarize()
+        assert counts == {'b1': 1, 'b2': 1}
+        index['b1'][0].connect()
+        index['b2'][0].connect()
+        await asyncio.sleep(0.1)
+        assert pool.is_in_state('running')
+
+        index, _ = ctx.summarize()
+        index['b1'][0].emit('error', Exception('test'))
+        index['b2'][0].emit('error', Exception('test'))
+        await asyncio.sleep(0.1)
+        # retries=2: one more attempt each; pool still running.
+        assert pool.is_in_state('running')
+        assert pool.get_last_error() is None
+
+        index, _ = ctx.summarize()
+        # Remove b2 while its replacement attempt is in-flight, then fail
+        # everything: pool must fail referencing only b1 (#144).
+        inner.emit('removed', 'b2')
+        index['b1'][0].emit('error', Exception('test2'))
+        index['b2'][0].emit('error', Exception('test2'))
+        await asyncio.sleep(0.1)
+        assert pool.is_in_state('failed')
+        assert pool.p_keys == ['b1']
+        assert pool.p_dead == {'b1': True}
+        pool.stop()
+        await settle(40)
+    run_async(t())
+
+
+def test_ping_checker_no_expand():
+    async def t():
+        ctx = Ctx()
+        checked = []
+
+        def checker(hdl, conn):
+            conn.checked = True
+            checked.append(conn)
+            hdl.release()
+
+        pool, inner = make_pool(ctx, spares=2, maximum=4,
+                                checker=checker, checkTimeout=30)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in ctx.connections:
+            c.connect()
+        await asyncio.sleep(0.15)
+        assert len(checked) >= 2
+        # Health pings must not grow the pool (reference
+        # test/pool.test.js:613-674 "pinger does not expand").
+        assert len(ctx.connections) == 2
+        pool.stop()
+        await settle(40)
+    run_async(t())
+
+
+def test_churn_rate_limit():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=4, maximum=4,
+                                maxChurnRate=4.0)
+        inner.emit('added', 'b1', {})
+        await settle()
+        # Churn limit of 4 conns/sec: the pool adds roughly one
+        # connection every 250ms instead of all four at once.
+        assert len(ctx.connections) == 1
+        ctx.connections[0].connect()
+
+        await asyncio.sleep(0.35)
+        assert len(ctx.connections) == 2
+        _, counts = ctx.summarize()
+        assert counts == {'b1': 2}
+        ctx.connections[1].connect()
+
+        await asyncio.sleep(0.25)
+        assert len(ctx.connections) == 3
+        ctx.connections[2].connect()
+
+        await asyncio.sleep(0.25)
+        assert len(ctx.connections) == 4
+        _, counts = ctx.summarize()
+        assert counts == {'b1': 4}
+        ctx.connections[3].connect()
+        pool.stop()
+        await settle(40)
+    run_async(t())

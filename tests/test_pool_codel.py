@@ -1,0 +1,99 @@
+"""CoDel overload-shedding statistical test (scaled port of reference
+test/codel.test.js:186-297): saturate a 2-connection pool with a claim
+load generator and assert the average claim sojourn tracks
+targetClaimDelay, with some successes AND some shed claims, and no other
+failure modes."""
+
+import asyncio
+
+from cueball_tpu import errors as mod_errors
+from cueball_tpu.utils import current_millis
+
+from conftest import run_async, settle, wait_for_state
+from test_pool import Ctx, make_pool
+
+
+HOLD_MS = 50          # claim hold time (reference: 50ms)
+CLAIMS_PER_TICK = 5   # 5 claims every 10ms (reference)
+TICK_MS = 10
+RUN_S = 2.0           # reference runs 5s; 2s keeps the suite quick
+TOLERANCE = 175       # reference asserts avg within +/-175ms of target
+
+
+async def run_load(pool):
+    stats = {'ok': 0, 'timeouts': 0, 'other': 0, 'delays': []}
+    pending = []
+
+    def make_claim():
+        start = current_millis()
+
+        def cb(err, hdl=None, conn=None):
+            if err is None:
+                stats['ok'] += 1
+                stats['delays'].append(current_millis() - start)
+                loop = asyncio.get_running_loop()
+                loop.call_later(HOLD_MS / 1000.0, hdl.release)
+            elif isinstance(err, mod_errors.ClaimTimeoutError):
+                stats['timeouts'] += 1
+            else:
+                stats['other'] += 1
+        pool.claim_cb({}, cb)
+
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + RUN_S
+    while loop.time() < deadline:
+        for _ in range(CLAIMS_PER_TICK):
+            make_claim()
+        await asyncio.sleep(TICK_MS / 1000.0)
+    # Let in-flight claims resolve.
+    await asyncio.sleep(1.0)
+    return stats
+
+
+def _run_target(target):
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2,
+                                targetClaimDelay=target)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+        assert pool.is_in_state('running')
+
+        stats = await run_load(pool)
+
+        assert stats['ok'] > 0, 'expected some successful claims'
+        assert stats['timeouts'] > 0, 'expected some shed claims'
+        assert stats['other'] == 0, 'unexpected failure modes'
+        avg = sum(stats['delays']) / len(stats['delays'])
+        assert abs(avg - target) < TOLERANCE, (
+            'avg claim delay %.1fms not within %dms of target %dms '
+            '(ok=%d shed=%d)' % (avg, TOLERANCE, target, stats['ok'],
+                                 stats['timeouts']))
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t(), timeout=30)
+
+
+def test_codel_tracks_300ms_target():
+    _run_target(300)
+
+
+def test_codel_tracks_1000ms_target():
+    _run_target(1000)
+
+
+def test_timeout_option_forbidden_with_codel():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, targetClaimDelay=300)
+        try:
+            pool.claim_cb({'timeout': 100}, lambda *a: None)
+            raise AssertionError('expected RuntimeError')
+        except RuntimeError as e:
+            assert 'not allowed' in str(e)
+        pool.stop()
+        await settle()
+    run_async(t())

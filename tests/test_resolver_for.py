@@ -1,0 +1,117 @@
+"""resolver_for_ip_or_domain factory tests (ported from reference
+test/resolver_for.test.js): bad argument types raise; well-formed but
+invalid input returns (not raises) an Error."""
+
+import pytest
+
+from cueball_tpu import resolver as mod_resolver
+
+from conftest import run_async, settle
+
+
+def test_bad_arguments_raise():
+    with pytest.raises(AssertionError):
+        mod_resolver.resolver_for_ip_or_domain({})
+    with pytest.raises(AssertionError):
+        mod_resolver.resolver_for_ip_or_domain('foobar')
+    with pytest.raises(AssertionError):
+        mod_resolver.resolver_for_ip_or_domain({'input': 47})
+    with pytest.raises(AssertionError):
+        mod_resolver.resolver_for_ip_or_domain(
+            {'input': 'foobar', 'resolverConfig': 17})
+
+
+def test_parse_ipv4():
+    r = mod_resolver.parse_ip_or_domain('127.0.0.1')
+    assert not isinstance(r, Exception)
+    assert r['kind'] == 'static'
+    assert r['config'] == {'backends': [
+        {'address': '127.0.0.1', 'port': None}]}
+
+    r = mod_resolver.parse_ip_or_domain('127.0.0.1:1234')
+    assert not isinstance(r, Exception)
+    assert r['kind'] == 'static'
+    assert r['config'] == {'backends': [
+        {'address': '127.0.0.1', 'port': 1234}]}
+
+
+def test_parse_bad_ports_return_error():
+    r = mod_resolver.parse_ip_or_domain('127.0.0.1:-3')
+    assert isinstance(r, Exception)
+    assert 'unsupported port in input:' in str(r)
+
+    r = mod_resolver.parse_ip_or_domain('127.0.0.1:ab123')
+    assert isinstance(r, Exception)
+    assert 'unsupported port in input:' in str(r)
+
+    r = mod_resolver.parse_ip_or_domain('myservice:-3')
+    assert isinstance(r, Exception)
+    assert 'unsupported port in input:' in str(r)
+
+
+def test_parse_hostname():
+    r = mod_resolver.parse_ip_or_domain('1.moray.emy-10.joyent.us')
+    assert not isinstance(r, Exception)
+    assert r['kind'] == 'dns'
+    assert r['config'] == {'domain': '1.moray.emy-10.joyent.us'}
+
+    r = mod_resolver.parse_ip_or_domain('myservice')
+    assert r['kind'] == 'dns'
+    assert r['config'] == {'domain': 'myservice'}
+
+    r = mod_resolver.parse_ip_or_domain('myservice:1234')
+    assert r['kind'] == 'dns'
+    assert r['config'] == {'domain': 'myservice', 'defaultPort': 1234}
+
+
+def test_config_merges_resolver_config():
+    r = mod_resolver.config_for_ip_or_domain({
+        'input': '127.0.0.1:8080',
+        'resolverConfig': {'maxDNSConcurrency': 7}})
+    assert not isinstance(r, Exception)
+    assert r['kind'] == 'static'
+    assert r['mergedConfig']['maxDNSConcurrency'] == 7
+    assert r['mergedConfig']['backends'] == [
+        {'address': '127.0.0.1', 'port': 8080}]
+
+    r = mod_resolver.config_for_ip_or_domain({
+        'input': 'myservice:123',
+        'resolverConfig': {'resolvers': ['8.8.8.8']}})
+    assert r['kind'] == 'dns'
+    assert r['mergedConfig']['resolvers'] == ['8.8.8.8']
+    assert r['mergedConfig']['domain'] == 'myservice'
+    assert r['mergedConfig']['defaultPort'] == 123
+
+
+def test_factory_builds_static_resolver():
+    async def t():
+        result = mod_resolver.resolver_for_ip_or_domain(
+            {'input': '127.0.0.1:8080'})
+        assert isinstance(result, mod_resolver.ResolverFSM)
+        result.start()
+        await settle(20)
+        lst = result.list()
+        assert len(lst) == 1
+        be = list(lst.values())[0]
+        assert be['address'] == '127.0.0.1'
+        assert be['port'] == 8080
+        result.stop()
+    run_async(t())
+
+
+def test_srv_key_stability():
+    k1 = mod_resolver.srv_key(
+        {'name': 'a', 'port': 80, 'address': '10.0.0.1'})
+    k2 = mod_resolver.srv_key(
+        {'name': 'a', 'port': 80, 'address': '10.0.0.1'})
+    k3 = mod_resolver.srv_key(
+        {'name': 'a', 'port': 81, 'address': '10.0.0.1'})
+    assert k1 == k2
+    assert k1 != k3
+    # IPv6 normalization: equivalent textual forms hash identically.
+    k4 = mod_resolver.srv_key(
+        {'name': 'a', 'port': 80, 'address': '2001:db8::1'})
+    k5 = mod_resolver.srv_key(
+        {'name': 'a', 'port': 80,
+         'address': '2001:0db8:0000:0000:0000:0000:0000:0001'})
+    assert k4 == k5

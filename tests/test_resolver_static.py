@@ -1,0 +1,118 @@
+"""Static resolver tests (ported from reference test/resolver_static.test.js)."""
+
+import pytest
+
+from cueball_tpu.resolver import StaticIpResolver, ResolverFSM, _StaticInner
+
+from conftest import run_async, settle
+
+
+def test_bad_arguments():
+    with pytest.raises(AssertionError, match='options'):
+        StaticIpResolver(None)
+    with pytest.raises(AssertionError, match='options.backends'):
+        StaticIpResolver({})
+    with pytest.raises(AssertionError, match='options.backends'):
+        StaticIpResolver({'backends': None})
+    with pytest.raises(AssertionError, match='options.backends'):
+        StaticIpResolver({'backends': [None]})
+    with pytest.raises(AssertionError,
+                       match=r'options.backends\[1\].address'):
+        StaticIpResolver({'backends': [
+            {'address': '127.0.0.1', 'port': 1234}, {}]})
+    with pytest.raises(AssertionError,
+                       match=r'options.backends\[1\].address'):
+        StaticIpResolver({'backends': [
+            {'address': '127.0.0.1', 'port': 1234},
+            {'address': 1234, 'port': 'foobar'}]})
+    with pytest.raises(AssertionError,
+                       match=r'options.backends\[1\].port'):
+        StaticIpResolver({'backends': [
+            {'address': '127.0.0.1', 'port': 1234},
+            {'address': '127.0.0.1'}]})
+    with pytest.raises(AssertionError,
+                       match=r'options.backends\[1\].port'):
+        StaticIpResolver({'backends': [
+            {'address': '127.0.0.1', 'port': 1234},
+            {'address': '127.0.0.1', 'port': 'foobar'}]})
+
+
+def test_no_backends():
+    async def t():
+        resolver = StaticIpResolver({'backends': []})
+        assert isinstance(resolver, ResolverFSM)
+        added = []
+        resolver.on('added', lambda k, b: added.append(b))
+        resolver.start()
+        await settle(20)
+        assert resolver.is_in_state('running')
+        assert added == []
+        assert resolver.list() == {}
+        assert resolver.count() == 0
+        resolver.stop()
+        await settle(20)
+        assert resolver.is_in_state('stopped')
+    run_async(t())
+
+
+def test_default_port():
+    async def t():
+        resolver = StaticIpResolver({
+            'defaultPort': 2021,
+            'backends': [
+                {'address': '10.0.0.3', 'port': 2022},
+                {'address': '10.0.0.4'},
+                {'address': '10.0.0.5'},
+            ]})
+        found = []
+        resolver.on('added', lambda k, b: found.append(b))
+        resolver.start()
+        await settle(20)
+        assert resolver.is_in_state('running')
+        assert resolver.count() == 3
+        assert found == [
+            {'name': '10.0.0.3:2022', 'address': '10.0.0.3', 'port': 2022},
+            {'name': '10.0.0.4:2021', 'address': '10.0.0.4', 'port': 2021},
+            {'name': '10.0.0.5:2021', 'address': '10.0.0.5', 'port': 2021},
+        ]
+        names = {be['name'] for be in found}
+        listed = {b['name'] for b in resolver.list().values()}
+        assert names == listed
+        resolver.stop()
+    run_async(t())
+
+
+def test_several_backends():
+    async def t():
+        resolver = StaticIpResolver({
+            'backends': [
+                {'address': '10.0.0.3', 'port': 2021},
+                {'address': '10.0.0.3', 'port': 2020},
+                {'address': '10.0.0.7', 'port': 2020},
+            ]})
+        found = []
+        resolver.on('added', lambda k, b: found.append(b))
+        resolver.start()
+        await settle(20)
+        assert resolver.count() == 3
+        assert found == [
+            {'name': '10.0.0.3:2021', 'address': '10.0.0.3', 'port': 2021},
+            {'name': '10.0.0.3:2020', 'address': '10.0.0.3', 'port': 2020},
+            {'name': '10.0.0.7:2020', 'address': '10.0.0.7', 'port': 2020},
+        ]
+        # All keys distinct (srv_key folds name+port+ip).
+        assert len(resolver.list()) == 3
+        resolver.stop()
+    run_async(t())
+
+
+def test_start_stop_misuse():
+    async def t():
+        inner = _StaticInner({'backends': []})
+        with pytest.raises(AssertionError):
+            inner.stop()  # stop before start
+        inner.start()
+        with pytest.raises(AssertionError):
+            inner.start()  # double start
+        inner.stop()
+    run_async(t())
