@@ -424,8 +424,11 @@ class CueBallClaimHandle(FSM):
     def _relinquish(self, event: str) -> None:
         if not self.is_in_state('claimed'):
             if self.is_in_state('released') or self.is_in_state('closed'):
-                who = self.ch_release_stack[2] if self.ch_release_stack \
-                    and len(self.ch_release_stack) > 2 else 'unknown'
+                who = 'unknown'
+                for line in (self.ch_release_stack or [])[2:]:
+                    if line.strip():
+                        who = line.strip()
+                        break
                 raise RuntimeError(
                     'Connection not claimed by this handle, released '
                     'by %s' % who)

@@ -1,0 +1,361 @@
+"""Minimal DNS wire-protocol client (mname-client replacement).
+
+The reference depends on Joyent's `mname-client` for DNS packet
+encode/decode and resolver fan-out (reference lib/resolver.js:24,
+385-392, 1210-1377). This is a from-scratch asyncio implementation of
+the parts cueball uses:
+
+- query encoding for SRV/AAAA/A lookups
+- response parsing with name decompression, answers/authority/additionals
+  sections, and the record types the resolver consumes
+  (A, AAAA, SRV, SOA, CNAME/DNAME recognition, OPT skipping)
+- UDP transport with TCP fallback when the TC (truncation) bit is set
+- multi-resolver fan-out with per-resolver error collection; when all
+  resolvers fail the caller receives a MultiError whose parts carry the
+  rcode, enabling the resolver's rcode-voting policy
+  (reference lib/resolver.js:1227-1259).
+
+Record objects are plain dicts with keys name/type/ttl/target/port,
+matching what the resolver's answer-processing expects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+
+# RR type codes
+TYPE_A = 1
+TYPE_CNAME = 5
+TYPE_SOA = 6
+TYPE_AAAA = 28
+TYPE_SRV = 33
+TYPE_OPT = 41
+TYPE_DNAME = 39
+
+TYPE_NAMES = {TYPE_A: 'A', TYPE_CNAME: 'CNAME', TYPE_SOA: 'SOA',
+              TYPE_AAAA: 'AAAA', TYPE_SRV: 'SRV', TYPE_OPT: 'OPT',
+              TYPE_DNAME: 'DNAME'}
+TYPE_CODES = {v: k for k, v in TYPE_NAMES.items()}
+
+RCODES = {0: 'NOERROR', 1: 'FORMERR', 2: 'SERVFAIL', 3: 'NXDOMAIN',
+          4: 'NOTIMP', 5: 'REFUSED'}
+
+CLASS_IN = 1
+
+
+class DnsError(Exception):
+    """Non-zero rcode from a nameserver; .code carries the rcode name."""
+
+    def __init__(self, code: str, domain: str, resolver: str | None = None):
+        self.code = code
+        self.domain = domain
+        self.resolver = resolver
+        super().__init__('DNS error %s for %s%s' % (
+            code, domain, ' from %s' % resolver if resolver else ''))
+
+
+class DnsTimeoutError(Exception):
+    """One resolver timed out. name attr mirrors mname-client's
+    TimeoutError identification (reference lib/resolver.js:1235)."""
+
+    name = 'TimeoutError'
+
+    def __init__(self, domain: str, resolver: str | None = None):
+        self.domain = domain
+        self.resolver = resolver
+        super().__init__('DNS timeout for %s%s' % (
+            domain, ' from %s' % resolver if resolver else ''))
+
+
+class MultiError(Exception):
+    """All resolvers failed; parts available via errors()
+    (verror MultiError analogue)."""
+
+    name = 'MultiError'
+
+    def __init__(self, errs: list):
+        self._errs = errs
+        super().__init__('all resolvers failed: %s' %
+                         '; '.join(str(e) for e in errs))
+
+    def errors(self) -> list:
+        return list(self._errs)
+
+
+# ---------------------------------------------------------------------------
+# Wire encoding / decoding
+
+def encode_name(name: str) -> bytes:
+    out = b''
+    for label in name.rstrip('.').split('.'):
+        raw = label.encode('idna') if not label.isascii() else \
+            label.encode()
+        if len(raw) > 63:
+            raise ValueError('DNS label too long: %r' % label)
+        out += bytes([len(raw)]) + raw
+    return out + b'\x00'
+
+
+def build_query(qid: int, domain: str, qtype: str) -> bytes:
+    flags = 0x0100  # RD
+    header = struct.pack('>HHHHHH', qid, flags, 1, 0, 0, 0)
+    question = encode_name(domain) + struct.pack(
+        '>HH', TYPE_CODES[qtype], CLASS_IN)
+    return header + question
+
+
+def _decode_name(data: bytes, off: int) -> tuple[str, int]:
+    labels = []
+    jumped = False
+    end = off
+    seen = set()
+    while True:
+        if off >= len(data):
+            raise ValueError('truncated name')
+        ln = data[off]
+        if ln & 0xC0 == 0xC0:
+            ptr = struct.unpack('>H', data[off:off + 2])[0] & 0x3FFF
+            if not jumped:
+                end = off + 2
+                jumped = True
+            if ptr in seen:
+                raise ValueError('name compression loop')
+            seen.add(ptr)
+            off = ptr
+            continue
+        off += 1
+        if ln == 0:
+            break
+        labels.append(data[off:off + ln].decode('ascii', 'replace'))
+        off += ln
+    if not jumped:
+        end = off
+    return '.'.join(labels), end
+
+
+def _parse_rr(data: bytes, off: int) -> tuple[dict, int]:
+    name, off = _decode_name(data, off)
+    rtype, rclass, ttl, rdlen = struct.unpack(
+        '>HHIH', data[off:off + 10])
+    off += 10
+    rdata = data[off:off + rdlen]
+    rdstart = off
+    off += rdlen
+
+    rr = {'name': name, 'type': TYPE_NAMES.get(rtype, rtype),
+          'ttl': ttl, 'target': None, 'port': None}
+    if rtype == TYPE_A and rdlen == 4:
+        rr['target'] = '.'.join(str(b) for b in rdata)
+    elif rtype == TYPE_AAAA and rdlen == 16:
+        import ipaddress
+        rr['target'] = str(ipaddress.IPv6Address(rdata))
+    elif rtype == TYPE_SRV:
+        prio, weight, port = struct.unpack('>HHH', rdata[:6])
+        tgt, _ = _decode_name(data, rdstart + 6)
+        rr.update({'priority': prio, 'weight': weight, 'port': port,
+                   'target': tgt})
+    elif rtype in (TYPE_CNAME, TYPE_DNAME):
+        tgt, _ = _decode_name(data, rdstart)
+        rr['target'] = tgt
+    elif rtype == TYPE_SOA:
+        mname, noff = _decode_name(data, rdstart)
+        rname, noff = _decode_name(data, noff)
+        serial, refresh, retry, expire, minimum = struct.unpack(
+            '>IIIII', data[noff:noff + 20])
+        rr.update({'mname': mname, 'minimum': minimum})
+    return rr, off
+
+
+class DnsMessage:
+    """Parsed response; mirrors the mname-client message interface the
+    resolver consumes (getAnswers/getAuthority/getAdditionals)."""
+
+    def __init__(self, qid: int, rcode: str, tc: bool,
+                 answers: list, authority: list, additionals: list):
+        self.qid = qid
+        self.rcode = rcode
+        self.tc = tc
+        self._answers = answers
+        self._authority = authority
+        self._additionals = additionals
+
+    def get_answers(self) -> list:
+        return self._answers
+
+    getAnswers = get_answers
+
+    def get_authority(self) -> list:
+        return self._authority
+
+    getAuthority = get_authority
+
+    def get_additionals(self) -> list:
+        return self._additionals
+
+    getAdditionals = get_additionals
+
+
+def parse_response(data: bytes) -> DnsMessage:
+    qid, flags, qd, an, ns, ar = struct.unpack('>HHHHHH', data[:12])
+    rcode = RCODES.get(flags & 0xF, 'RCODE%d' % (flags & 0xF))
+    tc = bool(flags & 0x0200)
+    off = 12
+    for _ in range(qd):
+        _, off = _decode_name(data, off)
+        off += 4
+    sections = []
+    for count in (an, ns, ar):
+        rrs = []
+        for _ in range(count):
+            rr, off = _parse_rr(data, off)
+            rrs.append(rr)
+        sections.append(rrs)
+    return DnsMessage(qid, rcode, tc, *sections)
+
+
+# ---------------------------------------------------------------------------
+# Transport
+
+class _UdpQuery(asyncio.DatagramProtocol):
+    def __init__(self, fut: asyncio.Future, qid: int):
+        self.fut = fut
+        self.qid = qid
+
+    def datagram_received(self, data, addr):
+        # Drop datagrams whose transaction ID doesn't match the query:
+        # qid randomization is the anti-spoofing entropy and is useless
+        # unless checked on receive.
+        if len(data) < 2 or \
+                struct.unpack('>H', data[:2])[0] != self.qid:
+            return
+        if not self.fut.done():
+            self.fut.set_result(data)
+
+    def error_received(self, exc):
+        if not self.fut.done():
+            self.fut.set_exception(exc)
+
+
+async def query_udp(resolver: str, port: int, payload: bytes,
+                    timeout_s: float) -> bytes:
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+    qid = struct.unpack('>H', payload[:2])[0]
+    transport, _ = await loop.create_datagram_endpoint(
+        lambda: _UdpQuery(fut, qid), remote_addr=(resolver, port))
+    try:
+        transport.sendto(payload)
+        return await asyncio.wait_for(fut, timeout_s)
+    finally:
+        transport.close()
+
+
+async def query_tcp(resolver: str, port: int, payload: bytes,
+                    timeout_s: float) -> bytes:
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(resolver, port), timeout_s)
+    try:
+        writer.write(struct.pack('>H', len(payload)) + payload)
+        await writer.drain()
+        ln = struct.unpack('>H', await asyncio.wait_for(
+            reader.readexactly(2), timeout_s))[0]
+        return await asyncio.wait_for(reader.readexactly(ln), timeout_s)
+    finally:
+        writer.close()
+
+
+class DnsClient:
+    """Resolver fan-out client (mname-client DnsClient equivalent).
+
+    lookup(opts, cb): opts = {domain, type, timeout (ms), resolvers,
+    errorThreshold?}; cb(err, msg). Tries resolvers in a randomized
+    order, UDP first with TCP fallback on truncation; stops at the first
+    clean answer. errorThreshold caps how many resolvers are tried
+    (used by bootstrap resolvers, reference lib/resolver.js:1216-1219).
+    """
+
+    def __init__(self, concurrency: int = 3):
+        self.concurrency = max(1, concurrency)
+
+    def lookup(self, opts: dict, cb) -> None:
+        asyncio.ensure_future(self._lookup(opts, cb))
+
+    async def _query_one(self, resolver: str, domain: str, qtype: str,
+                         timeout_s: float) -> DnsMessage:
+        host, _, portstr = resolver.partition('@')
+        port = int(portstr) if portstr else 53
+        qid = random.randrange(65536)
+        payload = build_query(qid, domain, qtype)
+        try:
+            data = await query_udp(host, port, payload, timeout_s)
+            msg = parse_response(data)
+            if msg.tc:
+                data = await query_tcp(host, port, payload, timeout_s)
+                msg = parse_response(data)
+        except (asyncio.TimeoutError, TimeoutError):
+            raise DnsTimeoutError(domain, resolver)
+        except struct.error as e:
+            # Malformed packet; surface as a parse error rather than
+            # letting it kill the lookup task.
+            raise ValueError('malformed DNS response from %s: %s' % (
+                resolver, e))
+        if msg.rcode != 'NOERROR':
+            raise DnsError(msg.rcode, domain, resolver)
+        return msg
+
+    async def _lookup(self, opts: dict, cb) -> None:
+        domain = opts['domain']
+        qtype = opts['type']
+        timeout_ms = opts.get('timeout') or 5000
+        resolvers = list(opts.get('resolvers') or [])
+        if not resolvers:
+            cb(MultiError([DnsError('SERVFAIL', domain)]), None)
+            return
+        threshold = opts.get('errorThreshold') or len(resolvers)
+
+        random.shuffle(resolvers)
+        resolvers = resolvers[:threshold]
+        errs: list[Exception] = []
+
+        # Bounded parallel fan-out: up to `concurrency` resolvers are
+        # queried at once; the first clean answer wins and the rest are
+        # cancelled (mname-client's concurrency semantics).
+        waves = [resolvers[i:i + self.concurrency]
+                 for i in range(0, len(resolvers), self.concurrency)]
+        per_wave_s = (timeout_ms / 1000.0) / len(waves)
+
+        try:
+            for wave in waves:
+                tasks = [
+                    asyncio.ensure_future(self._query_one(
+                        r, domain, qtype, per_wave_s))
+                    for r in wave]
+                try:
+                    pending = set(tasks)
+                    while pending:
+                        done, pending = await asyncio.wait(
+                            pending,
+                            return_when=asyncio.FIRST_COMPLETED)
+                        for task in done:
+                            try:
+                                msg = task.result()
+                            except asyncio.CancelledError:
+                                continue
+                            except Exception as e:
+                                errs.append(e)
+                                continue
+                            cb(None, msg)
+                            return
+                finally:
+                    for task in tasks:
+                        if not task.done():
+                            task.cancel()
+
+            if len(errs) == 1:
+                cb(errs[0], None)
+            else:
+                cb(MultiError(errs), None)
+        except Exception as e:  # defense: the callback must always fire
+            cb(e, None)

@@ -1,0 +1,114 @@
+"""Scripted fake DNS client (port of reference test/dns.test.js:75-306
+DummyDnsClient): synthesizes responses from naming conventions of the
+queried domain, records query history for exact-sequence assertions, and
+exposes mutable globals (use_a2, srv_ttl) to script topology/TTL changes
+mid-test.
+
+Conventions (domain suffix decides behavior):
+  *.ok        - 'srv.ok' SRV -> [a.ok:111, aaaa.ok:111] (+a2.ok if use_a2);
+                'dupe.ok' SRV -> duplicate targets; 'a.ok'/A -> 1.2.3.4;
+                'a2.ok'/A -> 1.2.3.5; 'a2.ok'/AAAA -> 1234:abcd::2 (ttl 1);
+                'aaaa.ok'/AAAA -> 1234:abcd::1; others -> NODATA
+  *.notfound  - NXDOMAIN for everything
+  *.notimp    - 'srv.notimp' SRV -> a.notimp; everything else NOTIMP
+  *.short-ttl - 'a.short-ttl'/A -> 1.2.3.4 with ttl 1; others NODATA
+  *.timeout   - times out after opts['timeout']
+"""
+
+from cueball_tpu.dns_client import DnsError, DnsMessage, DnsTimeoutError
+
+
+class Cfg:
+    use_a2 = False
+    srv_ttl = 3600
+
+
+def _rr(name, rtype, ttl, target, port=None):
+    return {'name': name, 'type': rtype, 'ttl': ttl, 'target': target,
+            'port': port}
+
+
+class FakeDnsClient:
+    instances = []
+
+    def __init__(self, concurrency=3):
+        self.history = []
+        FakeDnsClient.instances.append(self)
+
+    def lookup(self, opts, cb):
+        import asyncio
+        loop = asyncio.get_running_loop()
+
+        domain = opts['domain']
+        qtype = opts['type']
+        self.history.append(opts)
+
+        parts = domain.split('.')[::-1]
+        answers = []
+        authority = []
+        err = None
+
+        tld = parts[0]
+        if tld == 'ok':
+            if len(parts) > 2 and parts[1] == 'srv' and \
+                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+                answers.append(_rr(domain, 'SRV', Cfg.srv_ttl, 'a.ok',
+                                   111))
+                answers.append(_rr(domain, 'SRV', Cfg.srv_ttl, 'aaaa.ok',
+                                   111))
+                if Cfg.use_a2:
+                    answers.append(_rr(domain, 'SRV', Cfg.srv_ttl,
+                                       'a2.ok', 111))
+            elif len(parts) > 2 and parts[1] == 'dupe' and \
+                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+                answers.append(_rr(domain, 'SRV', Cfg.srv_ttl, 'dupe.ok',
+                                   112))
+                if Cfg.use_a2:
+                    answers.append(_rr(domain, 'SRV', Cfg.srv_ttl,
+                                       'dupe.ok', 112))
+            elif parts[1] == 'a' and qtype == 'A':
+                answers.append(_rr(domain, 'A', 3600, '1.2.3.4'))
+            elif parts[1] == 'a2' and qtype == 'A':
+                answers.append(_rr(domain, 'A', 3600, '1.2.3.5'))
+            elif parts[1] == 'a2' and qtype == 'AAAA':
+                answers.append(_rr(domain, 'AAAA', 1, '1234:abcd::2'))
+            elif parts[1] == 'aaaa' and qtype == 'AAAA':
+                answers.append(_rr(domain, 'AAAA', 3600, '1234:abcd::1'))
+            elif parts[1] == 'dupe' and qtype == 'A':
+                for _ in range(3):
+                    answers.append(_rr(domain, 'A', 3600, '1.2.3.1'))
+            elif parts[1] in ('a', 'aaaa', 'a2', 'dupe'):
+                pass  # NODATA
+            else:
+                err = DnsError('NXDOMAIN', domain)
+        elif tld == 'notfound':
+            err = DnsError('NXDOMAIN', domain)
+        elif tld == 'notimp':
+            if len(parts) > 2 and parts[1] == 'srv' and \
+                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+                answers.append(_rr(domain, 'SRV', 3600, 'a.notimp', 111))
+            else:
+                err = DnsError('NOTIMP', domain)
+        elif tld == 'short-ttl':
+            if parts[1] == 'a' and qtype == 'A':
+                answers.append(_rr(domain, 'A', 1, '1.2.3.4'))
+            else:
+                # Default rcode stays NXDOMAIN (reference fake leaves the
+                # initial rcode untouched off the matching branches).
+                err = DnsError('NXDOMAIN', domain)
+        elif tld == 'soa-ttl':
+            # NODATA carrying an SOA minimum TTL (newer-binder behavior,
+            # reference lib/resolver.js:1266-1279).
+            if parts[1] == 'a' and qtype == 'A':
+                answers.append(_rr(domain, 'A', 3600, '1.2.3.9'))
+            else:
+                authority.append(_rr(domain, 'SOA', 17, None))
+        elif tld == 'timeout':
+            loop.call_later(opts['timeout'] / 1000.0, cb,
+                            DnsTimeoutError(domain), None)
+            return
+        else:
+            raise RuntimeError('wat: %s' % domain)
+
+        msg = DnsMessage(1234, 'NOERROR', False, answers, authority, [])
+        loop.call_soon(cb, err, msg)

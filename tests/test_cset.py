@@ -1,0 +1,337 @@
+"""ConnectionSet tests (ported from reference test/cset.test.js):
+add/advertise, preferred-backend swap, backend removal with drain
+handles, removing unused backend (#47), connect-reject race (#92),
+never-drop-last-working-connection."""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu.cset import ConnectionSet
+from cueball_tpu.resolver import ResolverFSM
+
+from conftest import run_async, settle, wait_for_state
+from test_pool import Ctx, DummyConnection, DummyInner
+
+
+def make_cset(ctx, target=2, maximum=4, retries=1, timeout=500, delay=0,
+              recovery=None, **opts):
+    inner = DummyInner()
+    resolver = ResolverFSM(inner, {})
+    resolver.start()
+    cset = ConnectionSet({
+        'constructor': lambda backend: DummyConnection(ctx, backend),
+        'recovery': recovery or {'default': {
+            'timeout': timeout, 'retries': retries, 'delay': delay}},
+        'target': target,
+        'maximum': maximum,
+        'resolver': resolver,
+        **opts,
+    })
+    return cset, inner, resolver
+
+
+def test_cset_with_one_backend():
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=2, maximum=4)
+        added = []
+        removed = []
+        cset.on('added', lambda key, conn, hdl: added.append((key, conn)))
+
+        def on_removed(key, conn, hdl):
+            assert cset.is_in_state('stopping'), \
+                'removed outside stopping: %s' % key
+            removed.append(key)
+            hdl.release()
+        cset.on('removed', on_removed)
+
+        inner.emit('added', 'b1', {})
+        await settle()
+        assert len(ctx.connections) == 1  # singleton: one per backend
+        ctx.connections[0].connect()
+        await settle()
+        assert len(added) == 1
+        key, conn = added[0]
+        assert key.startswith(cset.cs_keys[0] + '.')
+        assert conn is ctx.connections[0]
+        assert conn.refd
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+        assert removed == [key]
+    run_async(t())
+
+
+def test_cset_with_two_backends():
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=2, maximum=4)
+        added = []
+        cset.on('added', lambda key, conn, hdl: added.append(conn))
+        cset.on('removed', lambda key, conn, hdl: hdl.release())
+
+        inner.emit('added', 'b1', {})
+        inner.emit('added', 'b2', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+        assert sorted(c.backend for c in added) == ['b1', 'b2']
+        assert len(ctx.connections) == 2
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
+
+
+def test_cset_swapping_to_preferred_backend():
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=1, maximum=1)
+        inset = []
+        cset.on('added', lambda key, conn, hdl: inset.append(conn))
+
+        def on_removed(key, conn, hdl):
+            assert not conn.dead  # drained while still alive
+            conn.seen = True
+            hdl.release()
+            if conn in inset:
+                inset.remove(conn)
+        cset.on('removed', on_removed)
+
+        inner.emit('added', 'b1', {})
+        await settle()
+        _, counts = ctx.summarize()
+        assert counts == {'b1': 1}
+        conn = ctx.connections[0]
+        conn.connect()
+        await asyncio.sleep(0.1)
+        assert len(inset) == 1
+
+        # Add a more-preferred backend: the set builds b0's slot first,
+        # and only drains b1 after b0 actually connects
+        # (reference test/cset.test.js:204-283).
+        inner.emit('added', 'b0', {})
+        cset.cs_keys.sort()
+        assert cset.cs_keys[0] == 'b0'
+        await asyncio.sleep(0.2)
+        _, counts = ctx.summarize()
+        assert counts == {'b1': 1, 'b0': 1}
+        assert not conn.dead
+        assert not getattr(conn, 'seen', False)
+
+        index, _ = ctx.summarize()
+        index['b0'][0].connect()
+        await asyncio.sleep(0.3)
+        assert len(inset) == 1
+        index, counts = ctx.summarize()
+        assert counts == {'b0': 1}
+        assert inset[0] is index['b0'][0]
+        assert conn.dead and conn.seen
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
+
+
+def test_removing_unused_backend_cueball_47():
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=2, maximum=5)
+        cset.on('added', lambda key, conn, hdl: None)
+
+        def on_removed(key, conn, hdl):
+            conn.seen = True
+            hdl.release()
+        cset.on('removed', on_removed)
+
+        inner.emit('added', 'b1', {})
+        inner.emit('added', 'b2', {})
+        inner.emit('added', 'b3', {})
+        bkeys = ['b1', 'b2', 'b3']
+        await settle()
+        assert len(ctx.connections) == 2  # target 2 of 3 backends
+        index, counts = ctx.summarize()
+        bs = [k for k in bkeys if counts.get(k, 0) > 0]
+        nbs = [k for k in bkeys if counts.get(k, 0) == 0]
+        assert len(bs) == 2
+        index[bs[0]][0].connect()
+        index[bs[1]][0].connect()
+
+        # Remove the backend that has no connection: nothing breaks.
+        inner.emit('removed', nbs[0])
+        await asyncio.sleep(0.2)
+        assert len(ctx.connections) == 2
+        _, counts = ctx.summarize()
+        assert counts.get(bs[0]) == 1
+        assert counts.get(bs[1]) == 1
+        assert nbs[0] not in counts
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
+
+
+def test_cset_connect_reject_race_cueball_92():
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(
+            ctx, target=2, maximum=4,
+            recovery={'default': {'timeout': 300, 'retries': 0,
+                                  'delay': 0}})
+        inset = []
+        states = []
+        cset.on('stateChanged', states.append)
+        cset.on('added', lambda key, conn, hdl: inset.append(key))
+
+        def on_removed(key, conn, hdl):
+            assert key in inset
+            inset.remove(key)
+            assert conn is not None and hdl is not None
+            conn.seen = True
+            hdl.release()
+        cset.on('removed', on_removed)
+
+        inner.emit('added', 'b1', {})
+        await settle()
+        # Connect then destroy in the next turn: the set must survive the
+        # claim/connect/close pile-up (#92) and end with nothing in-set.
+        for c in list(ctx.connections):
+            c.connect()
+            asyncio.get_running_loop().call_soon(
+                lambda c=c: (c.destroy(), c.emit('close')))
+        await asyncio.sleep(0.8)
+        # retries=0 -> the dead backend exhausts immediately -> failed.
+        assert cset.is_in_state('failed')
+        assert cset.get_last_error() is not None
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+        assert inset == []
+    run_async(t())
+
+
+def test_removing_last_backends_via_resolver():
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=3, maximum=5)
+        inset = []
+        cset.on('added', lambda key, conn, hdl: inset.append(key))
+
+        def on_removed(key, conn, hdl):
+            assert key in inset
+            inset.remove(key)
+            conn.seen = True
+            hdl.release()
+        cset.on('removed', on_removed)
+
+        for b in ('b1', 'b2', 'b3', 'b4'):
+            inner.emit('added', b, {})
+        cset.cs_keys.sort()
+        assert cset.cs_keys == ['b1', 'b2', 'b3', 'b4']
+        await settle()
+        assert len(ctx.connections) == 3
+        index, counts = ctx.summarize()
+        assert counts == {'b1': 1, 'b2': 1, 'b3': 1}
+        conn1 = index['b1'][0]
+        conn2 = index['b2'][0]
+        conn3 = index['b3'][0]
+        conn1.connect()
+        conn2.connect()
+        conn3.connect()
+        await asyncio.sleep(0.2)
+        assert len(inset) == 3
+
+        inner.emit('removed', 'b1')
+        inner.emit('removed', 'b2')
+        inner.emit('removed', 'b3')
+        await asyncio.sleep(0.4)
+        assert conn1.dead and conn2.dead and conn3.dead
+        assert conn1.seen and conn2.seen and conn3.seen
+        assert inset == []
+        _, counts = ctx.summarize()
+        assert counts == {'b4': 1}
+        index, _ = ctx.summarize()
+        index['b4'][0].connect()
+        await asyncio.sleep(0.2)
+        assert len(inset) == 1
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
+
+
+def test_set_target_resize():
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=1, maximum=4)
+        cset.on('added', lambda key, conn, hdl: None)
+        cset.on('removed', lambda key, conn, hdl: hdl.release())
+
+        for b in ('b1', 'b2', 'b3'):
+            inner.emit('added', b, {})
+        await settle()
+        assert len(ctx.connections) == 1
+
+        cset.set_target(3)
+        await settle()
+        assert len(ctx.connections) == 3
+        for c in list(ctx.connections):
+            c.connect()
+        await asyncio.sleep(0.1)
+
+        # Shrink again: drains down toward 1, never dropping the last
+        # working connection.
+        cset.set_target(1)
+        await asyncio.sleep(0.3)
+        working = [c for c in ctx.connections if c.connected]
+        assert len(working) >= 1
+        assert len(ctx.connections) == 1
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
+
+
+def test_assert_emit_crashes_unhandled():
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=1, maximum=2)
+        # No 'added' handler attached: advertising must crash loudly.
+        # The crash surfaces via the event loop's exception handler (the
+        # node analogue is an uncaught throw from an event handler).
+        crashes = []
+        loop = asyncio.get_running_loop()
+        loop.set_exception_handler(
+            lambda lp, c: crashes.append(c.get('exception')))
+        inner.emit('added', 'b1', {})
+        await settle()
+        ctx.connections[0].connect()
+        await settle()
+        assert any(isinstance(e, RuntimeError) and
+                   'must be handled' in str(e) for e in crashes)
+        loop.set_exception_handler(None)
+        cset.stop()
+        resolver.stop()
+    run_async(t())
+
+
+def test_cset_requires_recovery_default():
+    async def t():
+        from test_pool import DummyInner
+        inner = DummyInner()
+        resolver = ResolverFSM(inner, {})
+        with pytest.raises(AssertionError, match='recovery.default'):
+            ConnectionSet({
+                'constructor': lambda b: None,
+                'target': 1, 'maximum': 2,
+                'resolver': resolver,
+            })
+    run_async(t())

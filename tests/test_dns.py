@@ -1,0 +1,288 @@
+"""DNSResolver tests against the scripted fake DNS client (ported from
+reference test/dns.test.js): SRV happy path with exact query-history
+assertions, plain-A fallback, NXDOMAIN/NOTIMP => failed, per-record TTL
+expiry scheduling, no-IPv6 shortcut, duplicate record dedup."""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu import dns_resolver as mod_dns
+from cueball_tpu.dns_resolver import DNSResolver
+
+from conftest import run_async, settle, wait_for_state
+from fake_dns import Cfg, FakeDnsClient
+
+
+RECOVERY = {'default': {'timeout': 1000, 'retries': 3, 'delay': 100}}
+
+
+@pytest.fixture(autouse=True)
+def fake_v6(monkeypatch):
+    """Default: pretend we have a global v6 NIC (reference INT_V6)."""
+    monkeypatch.setattr(mod_dns, 'have_global_v6', lambda: True)
+    FakeDnsClient.instances = []
+    Cfg.use_a2 = False
+    Cfg.srv_ttl = 3600
+    yield
+
+
+def make_res(domain, **opts):
+    client = FakeDnsClient()
+    res = DNSResolver({
+        'domain': domain,
+        'service': '_foo._tcp',
+        'defaultPort': 112,
+        'resolvers': ['1.2.3.4'],
+        'recovery': RECOVERY,
+        'dnsClient': client,
+        **opts,
+    })
+    return res, client
+
+
+def history(client):
+    return ['%s/%s' % (o['domain'], o['type']) for o in client.history]
+
+
+def test_srv_lookup():
+    async def t():
+        res, client = make_res('srv.ok')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running')
+
+        assert len(backends) == 2
+        assert backends[0]['address'] == '1.2.3.4'
+        assert backends[0]['port'] == 111
+        assert backends[1]['address'] == '1234:abcd::1'
+        assert backends[1]['port'] == 111
+
+        # Exact query sequence (reference test/dns.test.js:342-354).
+        assert history(client) == [
+            '_foo._tcp.srv.ok/SRV',
+            'a.ok/AAAA',     # 1 try, NODATA
+            'aaaa.ok/AAAA',
+            'a.ok/A',
+            'aaaa.ok/A',     # 1 try, NODATA
+        ]
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_plain_a_lookup():
+    async def t():
+        res, client = make_res('a.ok')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running')
+
+        assert len(backends) == 1
+        assert backends[0]['address'] == '1.2.3.4'
+        assert backends[0]['port'] == 112   # defaultPort
+
+        assert history(client) == [
+            '_foo._tcp.a.ok/SRV',   # NODATA, no retries
+            'a.ok/AAAA',            # 1 try, NODATA
+            'a.ok/A',
+        ]
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_not_found_fails():
+    async def t():
+        res, client = make_res('foo.notfound')
+        res.on('added', lambda k, b: pytest.fail('no backends expected'))
+        res.start()
+        await wait_for_state(res, 'failed', timeout=10)
+        assert len(client.history) > 1
+        assert res.get_last_error() is not None
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_notimp_fails():
+    async def t():
+        res, client = make_res('a.notimp')
+        res.start()
+        await wait_for_state(res, 'failed', timeout=10)
+        assert len(client.history) > 1
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_srv_ok_notimp_addresses_fails():
+    async def t():
+        res, client = make_res('srv.notimp')
+        res.start()
+        await wait_for_state(res, 'failed', timeout=10)
+        assert len(client.history) > 1
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_short_ttl_requeries_only_expired_stage():
+    async def t():
+        res, client = make_res('a.short-ttl')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+
+        assert len(backends) == 1
+        assert backends[0]['address'] == '1.2.3.4'
+        assert backends[0]['port'] == 112
+        assert history(client) == [
+            '_foo._tcp.a.short-ttl/SRV',
+            'a.short-ttl/AAAA',
+            'a.short-ttl/AAAA',
+            'a.short-ttl/AAAA',   # 3 tries (NXDOMAIN is retried), give up
+            'a.short-ttl/A',
+        ]
+        client.history.clear()
+
+        # After the 1s A-record TTL, only the A stage re-runs.
+        await asyncio.sleep(1.5)
+        assert len(backends) == 1  # same backend, no flap
+        assert history(client) == ['a.short-ttl/A']
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_no_ipv6_shortcut(monkeypatch):
+    async def t():
+        monkeypatch.setattr(mod_dns, 'have_global_v6', lambda: False)
+        res, client = make_res('a.ok')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running')
+        assert len(backends) == 1
+        # AAAA queries skipped entirely (reference test/dns.test.js:687).
+        assert history(client) == [
+            '_foo._tcp.a.ok/SRV',
+            'a.ok/A',
+        ]
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_duped_records_dedup():
+    async def t():
+        res, client = make_res('srv.dupe.ok')
+        # Resolver must collapse duplicate SRV targets + A records into
+        # one backend (reference test/dns.test.js:732).
+        Cfg.use_a2 = True
+        added = []
+        removed = []
+        res.on('added', lambda k, b: added.append(k))
+        res.on('removed', lambda k: removed.append(k))
+        res.start()
+        await wait_for_state(res, 'running')
+        assert len(added) == 1
+        assert res.count() == 1
+        be = list(res.list().values())[0]
+        assert be['address'] == '1.2.3.1'
+        assert be['port'] == 112
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_soa_ttl_nodata():
+    async def t():
+        # SRV NODATA carries SOA minimum ttl=17: the next SRV re-check is
+        # scheduled from it rather than the 60-min default.
+        res, client = make_res('a.soa-ttl')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running')
+        assert len(backends) == 1
+        inner = res.r_fsm
+        import time
+        delta = inner.r_next_service - time.time()
+        assert 10 < delta <= 18, 'SRV recheck should use SOA ttl 17'
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_timeout_then_failure():
+    async def t():
+        res, client = make_res(
+            'x.timeout',
+            recovery={'default': {'timeout': 100, 'retries': 2,
+                                  'delay': 20}})
+        res.start()
+        await wait_for_state(res, 'failed', timeout=10)
+        # SRV retried then fell back per anti-flap (never seen SRV), then
+        # AAAA/A also timed out.
+        assert len(client.history) >= 4
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_srv_record_change_emits_removed_added():
+    async def t():
+        Cfg.srv_ttl = 1
+        res, client = make_res('srv.ok')
+        added = []
+        removed = []
+        res.on('added', lambda k, b: added.append(k))
+        res.on('removed', lambda k: removed.append(k))
+        res.start()
+        await wait_for_state(res, 'running')
+        assert len(added) == 2
+
+        # Topology change on next SRV expiry: a2.ok appears.
+        Cfg.use_a2 = True
+        await asyncio.sleep(1.6)
+        assert len(added) >= 3, 'expected a2 backend after SRV re-query'
+        assert not removed
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_bootstrap_dynamic_resolver_mode():
+    async def t():
+        # resolvers=['srv.ok'] (a name, not an IP): a shared bootstrap
+        # resolver looks it up via _dns._udp and feeds our nameserver
+        # list (reference lib/resolver.js:475-540).
+        client = FakeDnsClient()
+        res = DNSResolver({
+            'domain': 'a.ok',
+            'service': '_foo._tcp',
+            'defaultPort': 112,
+            'resolvers': ['srv.ok'],
+            'recovery': RECOVERY,
+            'dnsClient': client,
+        })
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+        inner = res.r_fsm
+        # The bootstrap fed real nameserver IPs from _dns._udp.srv.ok.
+        assert inner.r_bootstrap is not None
+        assert inner.r_resolvers, 'bootstrap should fill r_resolvers'
+        assert '1.2.3.4' in inner.r_resolvers
+        assert backends and backends[0]['address'] == '1.2.3.4'
+        # The bootstrap query went to _dns._udp.srv.ok.
+        hist = history(client)
+        assert '_dns._udp.srv.ok/SRV' in hist
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())

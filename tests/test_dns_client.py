@@ -1,0 +1,210 @@
+"""Wire-protocol DnsClient tests: encode/decode round trips and a live
+lookup against a scripted asyncio UDP nameserver on localhost."""
+
+import asyncio
+import struct
+
+from cueball_tpu import dns_client as dc
+
+from conftest import run_async
+
+
+def test_query_roundtrip_parse():
+    q = dc.build_query(0x1234, 'foo.example.com', 'SRV')
+    qid, flags, qd, an, ns, ar = struct.unpack('>HHHHHH', q[:12])
+    assert qid == 0x1234
+    assert qd == 1
+    name, off = dc._decode_name(q, 12)
+    assert name == 'foo.example.com'
+    rtype, rclass = struct.unpack('>HH', q[off:off + 4])
+    assert rtype == dc.TYPE_SRV
+    assert rclass == dc.CLASS_IN
+
+
+def _answer_packet(qid, question, rrs):
+    flags = 0x8180  # QR RD RA NOERROR
+    out = struct.pack('>HHHHHH', qid, flags, 1, len(rrs), 0, 0)
+    out += question
+    for name, rtype, ttl, rdata in rrs:
+        out += dc.encode_name(name)
+        out += struct.pack('>HHIH', rtype, dc.CLASS_IN, ttl, len(rdata))
+        out += rdata
+    return out
+
+
+class ScriptedNS(asyncio.DatagramProtocol):
+    """Answers A queries for any name with 10.1.2.3."""
+
+    def connection_made(self, transport):
+        self.transport = transport
+
+    def datagram_received(self, data, addr):
+        qid = struct.unpack('>H', data[:2])[0]
+        name, off = dc._decode_name(data, 12)
+        question = data[12:off + 4]
+        rtype = struct.unpack('>H', data[off:off + 2])[0]
+        if rtype == dc.TYPE_A:
+            rrs = [(name, dc.TYPE_A, 300, bytes([10, 1, 2, 3]))]
+        elif rtype == dc.TYPE_SRV:
+            rdata = struct.pack('>HHH', 0, 10, 8080) + \
+                dc.encode_name('backend.' + name)
+            rrs = [(name, dc.TYPE_SRV, 60, rdata)]
+        else:
+            rrs = []
+        self.transport.sendto(
+            _answer_packet(qid, question, rrs), addr)
+
+
+def test_live_udp_lookup():
+    async def t():
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            ScriptedNS, local_addr=('127.0.0.1', 0))
+        port = transport.get_extra_info('sockname')[1]
+
+        client = dc.DnsClient()
+        fut = loop.create_future()
+        client.lookup({
+            'domain': 'svc.test',
+            'type': 'A',
+            'timeout': 2000,
+            'resolvers': ['127.0.0.1@%d' % port],
+        }, lambda err, msg: fut.set_result((err, msg)))
+        err, msg = await asyncio.wait_for(fut, 5)
+        assert err is None
+        ans = msg.get_answers()
+        assert len(ans) == 1
+        assert ans[0]['type'] == 'A'
+        assert ans[0]['target'] == '10.1.2.3'
+        assert ans[0]['ttl'] == 300
+
+        # SRV with name decompression in the target.
+        fut2 = loop.create_future()
+        client.lookup({
+            'domain': 'svc.test',
+            'type': 'SRV',
+            'timeout': 2000,
+            'resolvers': ['127.0.0.1@%d' % port],
+        }, lambda err, msg: fut2.set_result((err, msg)))
+        err2, msg2 = await asyncio.wait_for(fut2, 5)
+        assert err2 is None
+        srv = msg2.get_answers()[0]
+        assert srv['type'] == 'SRV'
+        assert srv['target'] == 'backend.svc.test'
+        assert srv['port'] == 8080
+        transport.close()
+    run_async(t())
+
+
+def test_timeout_produces_timeout_error():
+    async def t():
+        loop = asyncio.get_running_loop()
+        # A UDP socket that never answers.
+        transport, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol, local_addr=('127.0.0.1', 0))
+        port = transport.get_extra_info('sockname')[1]
+        client = dc.DnsClient()
+        fut = loop.create_future()
+        client.lookup({
+            'domain': 'svc.test',
+            'type': 'A',
+            'timeout': 300,
+            'resolvers': ['127.0.0.1@%d' % port],
+        }, lambda err, msg: fut.set_result((err, msg)))
+        err, msg = await asyncio.wait_for(fut, 5)
+        assert isinstance(err, dc.DnsTimeoutError)
+        assert msg is None
+        transport.close()
+    run_async(t())
+
+
+def test_integration_dns_resolver_over_wire():
+    """Full stack: DNSResolver -> real DnsClient -> scripted UDP NS."""
+    async def t():
+        from cueball_tpu.dns_resolver import DNSResolver
+        from cueball_tpu import dns_resolver as mod_dns
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            ScriptedNS, local_addr=('127.0.0.1', 0))
+        port = transport.get_extra_info('sockname')[1]
+
+        orig = mod_dns.have_global_v6
+        mod_dns.have_global_v6 = lambda: False
+        try:
+            res = DNSResolver({
+                'domain': 'svc.test',
+                'service': '_svc._tcp',
+                'resolvers': ['127.0.0.1@%d' % port],
+                'recovery': {'default': {'timeout': 1000, 'retries': 2,
+                                         'delay': 50}},
+            })
+            backends = []
+            res.on('added', lambda k, b: backends.append(b))
+            res.start()
+            from conftest import wait_for_state
+            await wait_for_state(res, 'running', timeout=10)
+            # SRV gave backend.svc.test:8080, which resolves to 10.1.2.3.
+            assert backends and backends[0]['address'] == '10.1.2.3'
+            assert backends[0]['port'] == 8080
+            res.stop()
+            await wait_for_state(res, 'stopped')
+        finally:
+            mod_dns.have_global_v6 = orig
+            transport.close()
+    run_async(t())
+
+
+def test_malformed_response_does_not_hang():
+    async def t():
+        loop = asyncio.get_running_loop()
+
+        class GarbageNS(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                # Echo the qid so the ID check passes, then garbage.
+                self.transport.sendto(data[:2] + b'\xff' * 5, addr)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            GarbageNS, local_addr=('127.0.0.1', 0))
+        port = transport.get_extra_info('sockname')[1]
+        client = dc.DnsClient()
+        fut = loop.create_future()
+        client.lookup({
+            'domain': 'svc.test', 'type': 'A', 'timeout': 500,
+            'resolvers': ['127.0.0.1@%d' % port],
+        }, lambda err, msg: fut.set_result((err, msg)))
+        err, msg = await asyncio.wait_for(fut, 5)
+        assert err is not None  # malformed -> error, never a hang
+        transport.close()
+    run_async(t())
+
+
+def test_mismatched_qid_ignored():
+    async def t():
+        loop = asyncio.get_running_loop()
+
+        class SpoofingNS(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                # Answer with the WRONG transaction id: must be dropped.
+                bad = bytes([(data[0] + 1) % 256, data[1]]) + data[2:]
+                self.transport.sendto(bad, addr)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            SpoofingNS, local_addr=('127.0.0.1', 0))
+        port = transport.get_extra_info('sockname')[1]
+        client = dc.DnsClient()
+        fut = loop.create_future()
+        client.lookup({
+            'domain': 'svc.test', 'type': 'A', 'timeout': 400,
+            'resolvers': ['127.0.0.1@%d' % port],
+        }, lambda err, msg: fut.set_result((err, msg)))
+        err, msg = await asyncio.wait_for(fut, 5)
+        # The spoofed answer is ignored; the lookup times out instead.
+        assert isinstance(err, dc.DnsTimeoutError)
+        transport.close()
+    run_async(t())
