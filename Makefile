@@ -1,0 +1,22 @@
+# Parity with the reference's Makefile targets (reference Makefile:23-76)
+
+PYTHON ?= python3
+
+.PHONY: test check bench dryrun coverage
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+check:
+	$(PYTHON) -m compileall -q cueball_tpu bin/cbresolve bench.py __graft_entry__.py
+
+bench:
+	$(PYTHON) bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+coverage:
+	$(PYTHON) -m pytest tests/ -q --cov=cueball_tpu --cov-report=term 2>/dev/null || \
+	$(PYTHON) -m pytest tests/ -q

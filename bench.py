@@ -1,0 +1,202 @@
+"""Framework benchmark. Prints ONE JSON line.
+
+The reference publishes no benchmark numbers (BASELINE.md); its only
+quantified, test-enforced performance contract is CoDel claim-delay
+tracking: under saturation, average claim sojourn must sit within
++/-175 ms of targetClaimDelay (reference test/codel.test.js:245-297,
+driver config #4). That contract is the headline metric here:
+
+    value       = avg |claim sojourn - target| across targets (ms)
+    vs_baseline = 175 / value   (>1.0 == tracks tighter than the
+                                 reference's enforced envelope)
+
+Secondary fields: raw claim/release hot-path throughput on a saturated
+2-conn pool (driver config #1), and the TPU fleet-telemetry step rate
+(pools/sec through the jitted control-law step on the attached chip).
+"""
+
+import asyncio
+import json
+import time
+
+TARGETS = [300, 1000]
+HOLD_MS = 50
+CLAIMS_PER_TICK = 5
+TICK_MS = 10
+RUN_S = 4.0
+
+
+# ---------------------------------------------------------------------------
+# In-process instant-connect connection (isolates framework hot path).
+
+def make_fixture():
+    import cueball_tpu as cb
+    from cueball_tpu.events import EventEmitter
+    from cueball_tpu.fsm import get_loop
+
+    class InstantConnection(EventEmitter):
+        def __init__(self, backend):
+            super().__init__()
+            self.backend = backend
+            get_loop().call_soon(lambda: self.emit('connect'))
+
+        def destroy(self):
+            pass
+
+        def unref(self):
+            pass
+
+    class Inner(EventEmitter):
+        def __init__(self):
+            super().__init__()
+            self.backends = {'b1': {'address': '10.0.0.1', 'port': 1}}
+
+        def start(self):
+            def emit_all():
+                for k, b in self.backends.items():
+                    self.emit('added', k, b)
+                self.emit('updated')
+            get_loop().call_soon(emit_all)
+
+        def stop(self):
+            pass
+
+        def count(self):
+            return len(self.backends)
+
+        def list(self):
+            return dict(self.backends)
+
+    def build_pool(**opts):
+        inner = Inner()
+        resolver = cb.ResolverFSM(inner, {})
+        resolver.start()
+        return cb.ConnectionPool({
+            'domain': 'bench', 'resolver': resolver,
+            'constructor': InstantConnection,
+            'spares': 2, 'maximum': 2,
+            'recovery': {'default': {'timeout': 1000, 'retries': 3,
+                                     'delay': 100}},
+            **opts})
+    return build_pool
+
+
+async def settle(pool, timeout=10.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not pool.is_in_state('running'):
+        if asyncio.get_running_loop().time() > deadline:
+            raise RuntimeError('pool failed to start: %s' %
+                               pool.get_state())
+        await asyncio.sleep(0.01)
+
+
+async def bench_codel_tracking():
+    """Driver config #4: claim sojourn tracking under saturation."""
+    from cueball_tpu.utils import current_millis
+    from cueball_tpu.errors import ClaimTimeoutError
+    build_pool = make_fixture()
+    errors = []
+
+    async def run_target(target):
+        pool = build_pool(targetClaimDelay=target)
+        await settle(pool)
+        delays = []
+        other_errors = []
+
+        def make_claim():
+            start = current_millis()
+
+            def cb_(err, hdl=None, conn=None):
+                if err is None:
+                    delays.append(current_millis() - start)
+                    asyncio.get_running_loop().call_later(
+                        HOLD_MS / 1000.0, hdl.release)
+                elif not isinstance(err, ClaimTimeoutError):
+                    # Don't raise inside the pool's dispatch path;
+                    # PoolStoppingError for still-queued claims at
+                    # shutdown is expected.
+                    other_errors.append(err)
+            pool.claim_cb({}, cb_)
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + RUN_S
+        while loop.time() < deadline:
+            for _ in range(CLAIMS_PER_TICK):
+                make_claim()
+            await asyncio.sleep(TICK_MS / 1000.0)
+        await asyncio.sleep(1.0)
+        pool.stop()
+        if not delays:
+            raise RuntimeError(
+                'no claims succeeded at target %dms (errors: %r)' % (
+                    target, other_errors[:3]))
+        avg = sum(delays) / len(delays)
+        return abs(avg - target)
+
+    for t in TARGETS:
+        errors.append(await run_target(t))
+    return sum(errors) / len(errors)
+
+
+async def bench_claim_throughput():
+    """Driver config #1: raw claim/release cycles per second."""
+    build_pool = make_fixture()
+    pool = build_pool()
+    await settle(pool)
+
+    n = 0
+    t0 = time.perf_counter()
+    deadline = t0 + 3.0
+    while time.perf_counter() < deadline:
+        hdl, conn = await pool.claim({'timeout': 1000})
+        hdl.release()
+        n += 1
+    elapsed = time.perf_counter() - t0
+    pool.stop()
+    return n / elapsed
+
+
+def bench_telemetry_step():
+    """Jitted fleet-telemetry step rate on the attached accelerator."""
+    try:
+        import jax
+    except ImportError:
+        return None, None
+    from __graft_entry__ import entry
+    fn, args = entry()
+    step = jax.jit(fn)
+    out = step(*args)
+    jax.block_until_ready(out)  # compile
+    iters = 200
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(*args)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    n_pools = args[1].shape[0]
+    return n_pools * iters / dt, str(jax.devices()[0])
+
+
+async def main():
+    abs_err = await bench_codel_tracking()
+    claims_per_sec = await bench_claim_throughput()
+    telem_rate, device = bench_telemetry_step()
+
+    result = {
+        'metric': 'codel_claim_delay_abs_error_ms',
+        'value': round(abs_err, 2),
+        'unit': 'ms',
+        'vs_baseline': round(175.0 / abs_err, 2) if abs_err > 0 else 175.0,
+        'baseline': ('reference-enforced +/-175ms claim-delay tracking '
+                     'envelope (test/codel.test.js:245-297)'),
+        'claim_release_ops_per_sec': round(claims_per_sec, 1),
+        'telemetry_pools_per_sec': round(telem_rate, 1)
+        if telem_rate else None,
+        'device': device,
+        'targets_ms': TARGETS,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    asyncio.run(main())

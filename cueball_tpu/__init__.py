@@ -50,31 +50,20 @@ from .resolver import (
     Resolver,
     DNSResolver,
     StaticIpResolver,
+    ResolverFSM,
     resolver_for_ip_or_domain,
     config_for_ip_or_domain,
 )
 from .pool import ConnectionPool
 from .monitor import pool_monitor
-
-# Build staging (SURVEY.md §7.2): each remaining subsystem is guarded
-# individually so one missing module neither hides another nor breaks
-# `import *`; __all__ is built from the names actually bound.
-try:
-    from .cset import ConnectionSet
-except ModuleNotFoundError as _e:  # pragma: no cover - staged build only
-    if (_e.name or '') != 'cueball_tpu.cset':
-        raise
-try:
-    from .agent import HttpAgent, HttpsAgent
-except ModuleNotFoundError as _e:  # pragma: no cover - staged build only
-    if (_e.name or '') != 'cueball_tpu.agent':
-        raise
+from .cset import ConnectionSet
+from .agent import HttpAgent, HttpsAgent
 
 __version__ = '1.0.0'
 
-__all__ = [n for n in [
+__all__ = [
     'ConnectionPool', 'ConnectionSet',
-    'Resolver', 'DNSResolver', 'StaticIpResolver',
+    'Resolver', 'DNSResolver', 'StaticIpResolver', 'ResolverFSM',
     'resolver_for_ip_or_domain', 'config_for_ip_or_domain',
     'HttpAgent', 'HttpsAgent',
     'pool_monitor',
@@ -84,4 +73,4 @@ __all__ = [n for n in [
     'ClaimHandleMisusedError', 'ClaimTimeoutError', 'NoBackendsError',
     'PoolFailedError', 'PoolStoppingError', 'ConnectionError',
     'ConnectionTimeoutError', 'ConnectionClosedError',
-] if n in globals()]
+]

@@ -1,0 +1,464 @@
+"""HTTP agent: pooled HTTP(S) client transport.
+
+Rebuild of reference `lib/agent.js`. The reference plugs into Node's
+http.Agent contract; the asyncio-native equivalent is an HTTP/1.1 client
+whose transport claims connections from a cueball ConnectionPool per
+hostname:
+
+- pools are created lazily per host on first request
+  (reference lib/agent.js:105-211), or eagerly via ``initialDomains``
+- the socket constructor builds TCP or TLS connections with SNI and
+  TCP keep-alive (reference lib/agent.js:146-197)
+- request lifecycle maps onto the claim handle: response fully read on
+  a keep-alive connection -> release; close-delimited response or
+  error/cancel -> close (reference lib/agent.js:275-396)
+- optional HTTP ping health checks run a GET over idle pooled sockets;
+  a 5xx closes the connection, anything else releases it
+  (reference lib/agent.js:398-455, PingAgent at lib/agent.js:530-569)
+
+Public surface parity: ``request()`` (the addRequest analogue),
+``get_pool``, ``create_pool``, ``stop``, ``is_stopped``
+(reference lib/agent.js:275,458,464,213,497).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import ssl as mod_ssl
+
+from . import utils as mod_utils
+from .events import EventEmitter
+from .fsm import get_loop
+from .pool import ConnectionPool
+from .resolver import resolver_for_ip_or_domain
+
+# TLS fields passed through from agent options to the socket constructor
+# (reference lib/agent.js:96-97).
+PASS_FIELDS = ['certfile', 'keyfile', 'ca', 'ciphers', 'servername',
+               'rejectUnauthorized']
+
+
+class _WatchedProtocol(asyncio.StreamReaderProtocol):
+    """StreamReaderProtocol that reports connection loss to the owning
+    HttpSocket even while the connection sits idle in the pool. Node's
+    net.Socket emits 'close' on FIN regardless of reads; plain asyncio
+    streams only surface EOF at the next read, which would leave dead
+    idle connections undetected until claimed."""
+
+    def __init__(self, reader, owner, loop):
+        super().__init__(reader, loop=loop)
+        self._owner = owner
+
+    def eof_received(self):
+        super().eof_received()
+        # Close on FIN rather than lingering half-open (node's
+        # allowHalfOpen=false default) so connection_lost fires and the
+        # pool learns the backend hung up.
+        return False
+
+    def connection_lost(self, exc):
+        super().connection_lost(exc)
+        self._owner._on_connection_lost(exc)
+
+
+class HttpSocket(EventEmitter):
+    """Connection-interface object over an asyncio TCP/TLS stream
+    (the constructSocket analogue, reference lib/agent.js:146-197)."""
+
+    def __init__(self, backend: dict, tls: dict | None = None,
+                 tcp_keepalive_delay: float | None = None):
+        super().__init__()
+        self.backend = backend
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+        self.local_port: int | None = None
+        self.tls = tls
+        self.tcp_keepalive_delay = tcp_keepalive_delay
+        self.destroyed = False
+        self._task = asyncio.ensure_future(self._connect())
+
+    def _on_connection_lost(self, exc):
+        if self.destroyed:
+            return
+        if exc is not None:
+            self.emit('error', exc)
+        else:
+            self.emit('close')
+
+    def _ssl_context(self):
+        ctx = mod_ssl.create_default_context()
+        tls = self.tls or {}
+        if tls.get('ca'):
+            ctx.load_verify_locations(cadata=tls['ca'])
+        if tls.get('certfile'):
+            ctx.load_cert_chain(tls['certfile'], tls.get('keyfile'))
+        if tls.get('ciphers'):
+            ctx.set_ciphers(tls['ciphers'])
+        if tls.get('rejectUnauthorized') is False:
+            ctx.check_hostname = False
+            ctx.verify_mode = mod_ssl.CERT_NONE
+        return ctx
+
+    async def _connect(self):
+        try:
+            loop = asyncio.get_running_loop()
+            kwargs = {}
+            if self.tls is not None:
+                kwargs['ssl'] = self._ssl_context()
+                # SNI servername override (reference lib/agent.js:158).
+                kwargs['server_hostname'] = self.tls.get('servername') or \
+                    self.backend.get('name') or self.backend['address']
+            reader = asyncio.StreamReader(loop=loop)
+            transport, protocol = await loop.create_connection(
+                lambda: _WatchedProtocol(reader, self, loop),
+                self.backend['address'], self.backend['port'], **kwargs)
+            self.reader = reader
+            self.writer = asyncio.StreamWriter(
+                transport, protocol, reader, loop)
+            sock = transport.get_extra_info('socket')
+            if sock is not None:
+                import socket as mod_socket
+                self.local_port = sock.getsockname()[1]
+                # Keep-alive is always on (reference lib/agent.js:52,
+                # 188-191); the optional delay maps to TCP_KEEPIDLE.
+                sock.setsockopt(mod_socket.SOL_SOCKET,
+                                mod_socket.SO_KEEPALIVE, 1)
+                if self.tcp_keepalive_delay is not None and \
+                        hasattr(mod_socket, 'TCP_KEEPIDLE'):
+                    sock.setsockopt(
+                        mod_socket.IPPROTO_TCP,
+                        mod_socket.TCP_KEEPIDLE,
+                        max(1, int(self.tcp_keepalive_delay / 1000)))
+            self.emit('connect')
+        except (OSError, mod_ssl.SSLError) as e:
+            self.emit('error', e)
+        except asyncio.CancelledError:
+            pass
+
+    def destroy(self):
+        self.destroyed = True
+        if self.writer is not None:
+            self.writer.close()
+        elif not self._task.done():
+            self._task.cancel()
+
+    def unref(self):
+        pass
+
+    def ref(self):
+        pass
+
+
+class HttpResponse:
+    def __init__(self, status: int, reason: str, headers: dict,
+                 body: bytes):
+        self.status = status
+        self.status_code = status
+        self.reason = reason
+        self.headers = headers
+        self.body = body
+
+    def text(self, encoding='utf-8') -> str:
+        return self.body.decode(encoding, 'replace')
+
+
+async def _read_response(reader: asyncio.StreamReader,
+                         method: str) -> tuple[HttpResponse, bool]:
+    """Parse one HTTP/1.1 response; returns (response, keep_alive)."""
+    status_line = await reader.readline()
+    if not status_line:
+        raise ConnectionResetError('connection closed before response')
+    parts = status_line.decode('latin-1').rstrip('\r\n').split(' ', 2)
+    version = parts[0]
+    status = int(parts[1])
+    reason = parts[2] if len(parts) > 2 else ''
+
+    headers: dict[str, str] = {}
+    while True:
+        line = await reader.readline()
+        if line in (b'\r\n', b'\n', b''):
+            break
+        k, _, v = line.decode('latin-1').partition(':')
+        headers[k.strip().lower()] = v.strip()
+
+    keep_alive = version != 'HTTP/1.0'
+    conn_hdr = headers.get('connection', '').lower()
+    if conn_hdr == 'close':
+        keep_alive = False
+    elif conn_hdr == 'keep-alive':
+        keep_alive = True
+
+    body = b''
+    if method == 'HEAD' or status in (204, 304) or 100 <= status < 200:
+        pass
+    elif headers.get('transfer-encoding', '').lower() == 'chunked':
+        chunks = []
+        while True:
+            szline = await reader.readline()
+            if not szline.strip():
+                # EOF mid-stream is truncation, not a terminator.
+                raise ConnectionResetError(
+                    'connection closed mid-chunked-response')
+            size = int(szline.split(b';')[0].strip(), 16)
+            if size == 0:
+                # trailers until blank line
+                while True:
+                    t = await reader.readline()
+                    if t in (b'\r\n', b'\n', b''):
+                        break
+                break
+            chunks.append(await reader.readexactly(size))
+            await reader.readexactly(2)  # CRLF
+        body = b''.join(chunks)
+    elif 'content-length' in headers:
+        body = await reader.readexactly(int(headers['content-length']))
+    else:
+        body = await reader.read()
+        keep_alive = False
+
+    return HttpResponse(status, reason, headers, body), keep_alive
+
+
+class CueBallAgent(EventEmitter):
+    """Base agent (reference CueBallAgent, lib/agent.js:30-94)."""
+
+    def __init__(self, options: dict, protocol: str):
+        super().__init__()
+        if not isinstance(options, dict):
+            raise AssertionError('options must be a dict')
+        default_port = options.get('defaultPort')
+        if not isinstance(default_port, int):
+            raise AssertionError('options.defaultPort must be a number')
+        spares = options.get('spares')
+        maximum = options.get('maximum')
+        if not isinstance(spares, int) or not isinstance(maximum, int):
+            raise AssertionError(
+                'options.spares and options.maximum must be numbers')
+        recovery = options.get('recovery')
+        if not isinstance(recovery, dict):
+            raise AssertionError('options.recovery is required')
+        mod_utils.assert_recovery(recovery.get('default'),
+                                  'recovery.default')
+
+        self.collector = mod_utils.create_error_metrics(options)
+
+        self.default_port = default_port
+        self.protocol = protocol + ':'
+        self.service = '_%s._tcp' % protocol
+
+        self.tcp_ka_delay = options.get('tcpKeepAliveInitialDelay')
+        self.pools: dict[str, ConnectionPool] = {}
+        self.pool_resolvers: dict[str, object] = {}
+        self.resolvers = options.get('resolvers')
+        self.log = options.get('log') or logging.getLogger(
+            'cueball.agent')
+        self.cba_stopped = False
+        self.maximum = maximum
+        self.spares = spares
+        self.cba_ping = options.get('ping')
+        self.cba_ping_interval = options.get('pingInterval')
+        self.cba_recovery = recovery
+        self.cba_err_on_empty = options.get('errorOnEmpty')
+        self.cba_tls = {f: options[f] for f in PASS_FIELDS
+                        if f in options} \
+            if protocol == 'https' else None
+
+        for host in (options.get('initialDomains') or []):
+            self._add_pool(host, {})
+
+    # -- pool management --------------------------------------------------
+
+    def _make_socket(self, host: str):
+        tls = None
+        if self.cba_tls is not None:
+            tls = dict(self.cba_tls)
+            tls.setdefault('servername', host)
+
+        def construct(backend):
+            return HttpSocket(backend, tls=tls,
+                              tcp_keepalive_delay=self.tcp_ka_delay)
+        return construct
+
+    def _add_pool(self, host: str, options: dict) -> ConnectionPool:
+        port = options.get('port') or self.default_port
+        resolver = resolver_for_ip_or_domain({
+            'input': '%s:%d' % (host, port),
+            'resolverConfig': {
+                'resolvers': self.resolvers,
+                'service': self.service,
+                'maxDNSConcurrency': 3,
+                'recovery': self.cba_recovery,
+                'log': self.log,
+            }})
+        if isinstance(resolver, Exception):
+            raise resolver
+
+        pool_opts = {
+            'domain': host,
+            'resolver': resolver,
+            'constructor': self._make_socket(host),
+            'maximum': self.maximum,
+            'spares': self.spares,
+            'log': self.log,
+            'recovery': self.cba_recovery,
+            'collector': self.collector,
+        }
+        if self.cba_ping is not None:
+            pool_opts['checker'] = self._make_checker(host)
+            pool_opts['checkTimeout'] = self.cba_ping_interval or 30000
+        pool = ConnectionPool(pool_opts)
+        resolver.start()
+        self.pools[host] = pool
+        self.pool_resolvers[host] = resolver
+        return pool
+
+    def get_pool(self, host: str) -> ConnectionPool | None:
+        return self.pools.get(host)
+
+    getPool = get_pool
+
+    def create_pool(self, host: str, options: dict | None = None) -> None:
+        """Pre-create the pool for a host; a duplicate is an error
+        (reference lib/agent.js:464-488)."""
+        if host in self.pools:
+            raise RuntimeError(
+                'Attempting to create a pool for a hostname that '
+                'already has one: %s' % host)
+        self._add_pool(host, options or {})
+
+    createPool = create_pool
+
+    def is_stopped(self) -> bool:
+        return self.cba_stopped
+
+    isStopped = is_stopped
+
+    async def stop(self) -> None:
+        """Stop all pools and their resolvers
+        (reference lib/agent.js:213-265)."""
+        assert not self.cba_stopped, 'agent already stopped'
+        self.cba_stopped = True
+        pools = list(self.pools.values())
+        resolvers = list(self.pool_resolvers.values())
+        for pool in pools:
+            pool.stop()
+        for pool in pools:
+            while not pool.is_in_state('stopped'):
+                await asyncio.sleep(0.01)
+        for res in resolvers:
+            if not res.is_in_state('stopped'):
+                res.stop()
+        self.pools = {}
+        self.pool_resolvers = {}
+
+    # -- health checking --------------------------------------------------
+
+    def _make_checker(self, host: str):
+        def checker(handle, socket):
+            asyncio.ensure_future(
+                self._check_socket(host, handle, socket))
+        return checker
+
+    async def _check_socket(self, host: str, handle, socket) -> None:
+        """GET the ping path over this very socket; 5xx or failure
+        closes it, success releases it
+        (reference lib/agent.js:398-455)."""
+        t1 = get_loop().time()
+        try:
+            resp = await asyncio.wait_for(
+                self._do_request_on('GET', host, self.cba_ping, {},
+                                    b'', socket),
+                timeout=30)
+            resp_obj, keep_alive = resp
+            latency = (get_loop().time() - t1) * 1000
+            if 500 <= resp_obj.status < 600:
+                self.log.warning(
+                    'health check on %s got %d (latency %.0fms), '
+                    'closing', host, resp_obj.status, latency)
+                handle.close()
+            elif not keep_alive:
+                handle.close()
+            else:
+                self.log.debug('health check on %s ok (%d)', host,
+                               resp_obj.status)
+                handle.release()
+        except Exception as e:
+            self.log.warning('health check on %s failed: %r', host, e)
+            try:
+                handle.close()
+            except RuntimeError:
+                pass
+
+    # -- requests ---------------------------------------------------------
+
+    async def _do_request_on(self, method: str, host: str, path: str,
+                             headers: dict, body: bytes, socket):
+        hdrs = {'host': host, 'connection': 'keep-alive'}
+        hdrs.update({k.lower(): v for k, v in (headers or {}).items()})
+        if body:
+            hdrs['content-length'] = str(len(body))
+        lines = ['%s %s HTTP/1.1' % (method, path)]
+        lines += ['%s: %s' % (k, v) for k, v in hdrs.items()]
+        payload = ('\r\n'.join(lines) + '\r\n\r\n').encode('latin-1') + \
+            (body or b'')
+        socket.writer.write(payload)
+        await socket.writer.drain()
+        return await _read_response(socket.reader, method)
+
+    async def request(self, method: str, host: str, path: str = '/',
+                      headers: dict | None = None, body: bytes = b'',
+                      port: int | None = None,
+                      timeout: float | None = None) -> HttpResponse:
+        """Claim a pooled connection to `host`, run one HTTP request,
+        and release/close per keep-alive semantics (the addRequest
+        analogue, reference lib/agent.js:275-396)."""
+        if self.cba_stopped:
+            raise RuntimeError('agent has been stopped')
+        pool = self.pools.get(host)
+        if pool is None:
+            pool = self._add_pool(host, {'port': port})
+
+        claim_opts = {}
+        if timeout is not None:
+            claim_opts['timeout'] = timeout
+        if self.cba_err_on_empty is not None:
+            claim_opts['errorOnEmpty'] = self.cba_err_on_empty
+
+        handle, socket = await pool.claim(claim_opts)
+        try:
+            resp, keep_alive = await self._do_request_on(
+                method, host, path, headers or {}, body, socket)
+        except asyncio.CancelledError:
+            # Request aborted mid-flight: connection state unknown.
+            handle.close()
+            raise
+        except Exception:
+            handle.close()
+            raise
+        if keep_alive:
+            handle.release()
+        else:
+            handle.close()
+        return resp
+
+    async def get(self, host: str, path: str = '/', **kw) -> HttpResponse:
+        return await self.request('GET', host, path, **kw)
+
+    async def post(self, host: str, path: str = '/', body: bytes = b'',
+                   **kw) -> HttpResponse:
+        return await self.request('POST', host, path, body=body, **kw)
+
+
+class HttpAgent(CueBallAgent):
+    """reference lib/agent.js:501-507"""
+
+    def __init__(self, options: dict):
+        super().__init__(options, 'http')
+
+
+class HttpsAgent(CueBallAgent):
+    """reference lib/agent.js:509-515"""
+
+    def __init__(self, options: dict):
+        super().__init__(options, 'https')

@@ -1,0 +1,81 @@
+"""Kang-style debug HTTP server.
+
+The reference exposes pool-monitor snapshots over Joyent's kang protocol,
+with the HTTP server supplied by the consumer (kang is a devDependency;
+reference lib/pool-monitor.js:60-216, test/monitor.test.js). Here the
+framework ships its own minimal asyncio HTTP endpoint:
+
+    GET /kang/snapshot          - full snapshot of all registered objects
+    GET /kang/types             - ['pool', 'set', 'dns_res']
+    GET /kang/objects/<type>    - ids of registered objects of a type
+    GET /kang/obj/<type>/<id>   - one object's snapshot
+    GET /metrics                - prometheus text metrics (collector)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from .monitor import pool_monitor
+
+
+def _json_default(o):
+    return repr(o)
+
+
+async def _serve_client(reader, writer, collector=None):
+    try:
+        line = await reader.readline()
+        if not line:
+            return
+        parts = line.decode('latin-1').split(' ')
+        if len(parts) < 2:
+            return
+        method, path = parts[0], parts[1]
+        while True:
+            h = await reader.readline()
+            if h in (b'\r\n', b'\n', b''):
+                break
+
+        status = 200
+        ctype = 'application/json'
+        try:
+            if path == '/kang/snapshot':
+                body = json.dumps(pool_monitor.snapshot(),
+                                  default=_json_default).encode()
+            elif path == '/kang/types':
+                body = json.dumps(pool_monitor.list_types()).encode()
+            elif path.startswith('/kang/objects/'):
+                t = path.split('/')[3]
+                body = json.dumps(pool_monitor.list_objects(t)).encode()
+            elif path.startswith('/kang/obj/'):
+                _, _, _, t, id_ = path.split('/', 4)
+                body = json.dumps(pool_monitor.get(t, id_),
+                                  default=_json_default).encode()
+            elif path == '/metrics' and collector is not None:
+                body = collector.collect().encode()
+                ctype = 'text/plain; version=0.0.4'
+            else:
+                status, body = 404, b'{"error": "not found"}'
+        except (KeyError, ValueError, IndexError) as e:
+            status, body = 404, json.dumps(
+                {'error': str(e)}).encode()
+
+        writer.write(
+            b'HTTP/1.1 %d %s\r\nContent-Type: %s\r\n'
+            b'Content-Length: %d\r\nConnection: close\r\n\r\n' % (
+                status, b'OK' if status == 200 else b'Not Found',
+                ctype.encode(), len(body)) + body)
+        await writer.drain()
+    finally:
+        writer.close()
+
+
+async def serve_monitor(port: int = 0, host: str = '127.0.0.1',
+                        collector=None):
+    """Start the kang endpoint; returns the asyncio server (its bound
+    port via server.sockets[0].getsockname()[1])."""
+    return await asyncio.start_server(
+        lambda r, w: _serve_client(r, w, collector=collector),
+        host, port)

@@ -1,0 +1,24 @@
+"""Batched JAX/TPU implementations of the framework's numeric control
+algorithms.
+
+The per-pool runtime uses the scalar Python forms (pool.FIRFilter,
+codel.ControlledDelay, utils.gen_delay) — one pool's control math is a
+handful of flops and belongs on the host next to the event loop. These
+modules are the fleet-scale forms: a TPU-host process supervising
+telemetry for thousands of pools/queues batches the same control laws
+into dense [pools, ...] arrays where XLA can fuse and tile them.
+
+- ops.fir: the 128-tap EMA low-pass filter (reference lib/pool.js:37-100)
+- ops.backoff: exponential backoff schedules with randomized spread
+  (reference lib/connection-fsm.js:361-394, lib/utils.js:446-461)
+- ops.codel_batch: the CoDel control law as a lax.scan
+  (reference lib/codel.js)
+"""
+
+from .fir import gen_taps, fir_apply, fir_smooth, fir_apply_pallas
+from .backoff import backoff_schedule, spread_delays
+from .codel_batch import codel_scan, CodelState
+
+__all__ = ['gen_taps', 'fir_apply', 'fir_smooth', 'fir_apply_pallas',
+           'backoff_schedule', 'spread_delays', 'codel_scan',
+           'CodelState']

@@ -1,0 +1,36 @@
+"""Vectorized exponential-backoff schedules.
+
+The SocketMgr doubles delay/timeout per attempt with caps and a
+randomized +/- spread/2 jitter to decorrelate retry herds (reference
+lib/connection-fsm.js:361-394, lib/utils.js:446-461). Computing the
+whole schedule for a fleet of [N] connections (or the full [N, R]
+attempt table) is a couple of fused elementwise ops on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=('retries',))
+def backoff_schedule(delay, max_delay, retries: int):
+    """Per-attempt base delays [N, R]: delay * 2^r clamped to max_delay
+    (the deterministic part of the SocketMgr backoff ladder)."""
+    delay = jnp.asarray(delay, jnp.float32)
+    max_delay = jnp.asarray(max_delay, jnp.float32)
+    growth = jnp.exp2(jnp.arange(retries, dtype=jnp.float32))
+    return jnp.minimum(delay[:, None] * growth[None, :],
+                       max_delay[:, None])
+
+
+@jax.jit
+def spread_delays(base, spread, uniforms):
+    """Apply the randomized spread: base * (1 - spread/2 + u * spread),
+    u ~ U(0,1) supplied by the caller (reference lib/utils.js:446-461;
+    randomness is passed in so the op stays a pure function)."""
+    base = jnp.asarray(base, jnp.float32)
+    spread = jnp.asarray(spread, jnp.float32)
+    return jnp.round(base * (1.0 - spread / 2.0 + uniforms * spread))

@@ -1,0 +1,81 @@
+"""Batched CoDel control law as a lax.scan.
+
+The same controlled-delay algorithm the pool runs per claim queue
+(reference lib/codel.js, cueball_tpu/codel.py), restructured for TPU:
+Q queues advance in lockstep through T dequeue events, carrying
+(first_above_time, drop_next, count, dropping) as dense state. All
+branching is jnp.where — no data-dependent Python control flow — so the
+whole scan compiles to one fused loop.
+"""
+
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+CODEL_INTERVAL = 100.0  # ms (reference lib/codel.js:16)
+
+
+class CodelState(typing.NamedTuple):
+    first_above: jax.Array  # [Q] ms timestamp, 0 = unset
+    drop_next: jax.Array    # [Q] ms timestamp
+    count: jax.Array        # [Q] drops in current dropping run
+    dropping: jax.Array     # [Q] bool
+
+
+def codel_init(num_queues: int) -> CodelState:
+    z = jnp.zeros((num_queues,), jnp.float32)
+    return CodelState(z, z, z, jnp.zeros((num_queues,), bool))
+
+
+def _step(target: jax.Array, state: CodelState, inputs):
+    now, sojourn = inputs  # now: scalar ms; sojourn: [Q] ms
+
+    below = sojourn < target
+    first_unset = state.first_above == 0.0
+    # can_drop per reference lib/codel.js:34-46
+    new_first = jnp.where(
+        below, 0.0,
+        jnp.where(first_unset, now + CODEL_INTERVAL, state.first_above))
+    ok_to_drop = (~below) & (~first_unset) & (now >= state.first_above)
+
+    # dropping branch (reference lib/codel.js:62-68)
+    leave_dropping = state.dropping & ~ok_to_drop
+    drop_in_run = state.dropping & ok_to_drop & (now >= state.drop_next)
+    count_a = jnp.where(drop_in_run, state.count + 1, state.count)
+
+    # enter-dropping branch (reference lib/codel.js:69-85)
+    recent = (now - state.drop_next) < CODEL_INTERVAL
+    long_above = (now - state.first_above) >= CODEL_INTERVAL
+    enter = (~state.dropping) & ok_to_drop & (recent | long_above)
+    count_b = jnp.where(
+        enter,
+        jnp.where(recent & (count_a > 2), count_a - 2, 1.0),
+        count_a)
+    drop_next = jnp.where(
+        enter | drop_in_run,
+        now + CODEL_INTERVAL / jnp.sqrt(jnp.maximum(count_b, 1.0)),
+        state.drop_next)
+
+    dropping = (state.dropping & ~leave_dropping) | enter
+    drop = drop_in_run | enter
+
+    return CodelState(new_first, drop_next, count_b, dropping), drop
+
+
+def codel_scan(sojourns: jax.Array, times: jax.Array,
+               target: float,
+               state: CodelState | None = None):
+    """Run CoDel over a trace.
+
+    sojourns: [T, Q] queue sojourn times (ms) at each dequeue event;
+    times: [T] monotonic ms clock; target: ms. Returns (final_state,
+    drops [T, Q] bool).
+    """
+    if state is None:
+        state = codel_init(sojourns.shape[1])
+    tgt = jnp.float32(target)
+    return jax.lax.scan(
+        lambda s, x: _step(tgt, s, x), state, (times, sojourns))

@@ -1,0 +1,16 @@
+"""Mesh-sharded fleet telemetry.
+
+SURVEY.md §7.1 is explicit: the reference has no tensor programs, so
+there is no training step to shard. What a TPU host running this
+framework *does* have at scale is control-plane telemetry: thousands of
+pools' load samples and claim-queue sojourns. parallel.telemetry batches
+the framework's control laws (FIR shrink damping, rebalance targeting,
+CoDel) into one jitted step, sharded over a `jax.sharding.Mesh` 'pools'
+axis, with the fleet-wide aggregates (mean load, overload fraction)
+becoming XLA all-reduces over ICI.
+"""
+
+from .telemetry import (FleetState, fleet_init, fleet_step,
+                        make_sharded_step)
+
+__all__ = ['FleetState', 'fleet_init', 'fleet_step', 'make_sharded_step']

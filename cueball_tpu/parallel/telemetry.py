@@ -1,0 +1,121 @@
+"""Fleet telemetry step: the framework's control laws, batched + sharded.
+
+One step consumes, for every pool in a fleet:
+- a load sample (busy + spares, what the 5 Hz LP timer feeds per pool,
+  reference lib/pool.js:251-262)
+- the current claim-queue sojourn (ms)
+
+and produces, per pool:
+- the FIR-filtered load (128-tap EMA, reference lib/pool.js:44-100)
+- the clamped rebalance target (reference lib/pool.js:573-592)
+- the CoDel drop decision (reference lib/codel.js)
+
+plus fleet-wide aggregates (mean load, overload fraction) that become
+XLA all-reduces when the pools axis is sharded over a Mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import typing
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.codel_batch import CodelState, codel_init, _step as codel_step
+from ..ops.fir import fir_apply, gen_taps
+
+
+class FleetState(typing.NamedTuple):
+    windows: jax.Array      # [pools, taps] load sample ring (old->new)
+    codel: CodelState       # [pools] CoDel control state
+    now_ms: jax.Array       # scalar clock
+
+
+def fleet_init(n_pools: int, taps: int = 128) -> FleetState:
+    return FleetState(
+        windows=jnp.zeros((n_pools, taps), jnp.float32),
+        codel=codel_init(n_pools),
+        now_ms=jnp.float32(0.0))
+
+
+@functools.partial(jax.jit, static_argnames=('spares', 'maximum'))
+def fleet_step(state: FleetState, samples: jax.Array,
+               sojourns: jax.Array, target_delay: jax.Array,
+               spares: int = 4, maximum: int = 16):
+    """One telemetry tick for the whole fleet.
+
+    samples: [pools] current busy+spares load; sojourns: [pools] claim
+    sojourn ms; target_delay: [pools] per-pool CoDel target ms.
+    """
+    taps = gen_taps(state.windows.shape[1])
+
+    windows = jnp.concatenate(
+        [state.windows[:, 1:], samples[:, None]], axis=1)
+    filtered = fir_apply(windows, taps)
+
+    # Rebalance target with LP clamp (reference lib/pool.js:573-592):
+    # shrink no faster than the filtered recent load allows.
+    raw_target = samples + spares
+    lp_min = jnp.ceil(filtered)
+    clamped = raw_target < lp_min * 1.05
+    target = jnp.where(clamped, lp_min, raw_target)
+    target = jnp.minimum(target, maximum)
+
+    now = state.now_ms + 200.0  # 5 Hz tick
+    codel_state, drops = codel_step(
+        target_delay, state.codel, (now, sojourns))
+
+    # Fleet aggregates: all-reduces over the sharded pools axis.
+    fleet = {
+        'mean_load': jnp.mean(samples),
+        'mean_filtered': jnp.mean(filtered),
+        'overload_frac': jnp.mean(drops.astype(jnp.float32)),
+        'max_sojourn': jnp.max(sojourns),
+    }
+
+    new_state = FleetState(windows=windows, codel=codel_state,
+                           now_ms=now)
+    out = {'filtered': filtered, 'target': target,
+           'clamped': clamped, 'drop': drops}
+    return new_state, out, fleet
+
+
+def make_sharded_step(mesh: Mesh, spares: int = 4, maximum: int = 16):
+    """Build a jitted step with every [pools, ...] array sharded over
+    the mesh's 'pools' axis. The per-pool math is embarrassingly
+    parallel (no resharding); the fleet aggregates compile to psum-style
+    all-reduces over ICI."""
+    pool_sharding = NamedSharding(mesh, P('pools'))
+    window_sharding = NamedSharding(mesh, P('pools', None))
+    scalar = NamedSharding(mesh, P())
+
+    state_shardings = FleetState(
+        windows=window_sharding,
+        codel=CodelState(pool_sharding, pool_sharding, pool_sharding,
+                         pool_sharding),
+        now_ms=scalar)
+    out_shardings = (
+        state_shardings,
+        {'filtered': pool_sharding, 'target': pool_sharding,
+         'clamped': pool_sharding, 'drop': pool_sharding},
+        {'mean_load': scalar, 'mean_filtered': scalar,
+         'overload_frac': scalar, 'max_sojourn': scalar})
+
+    return jax.jit(
+        functools.partial(fleet_step, spares=spares, maximum=maximum),
+        in_shardings=(state_shardings, pool_sharding, pool_sharding,
+                      pool_sharding),
+        out_shardings=out_shardings)
+
+
+def shard_state(state: FleetState, mesh: Mesh) -> FleetState:
+    pool_sharding = NamedSharding(mesh, P('pools'))
+    window_sharding = NamedSharding(mesh, P('pools', None))
+    scalar = NamedSharding(mesh, P())
+    return FleetState(
+        windows=jax.device_put(state.windows, window_sharding),
+        codel=CodelState(
+            *[jax.device_put(x, pool_sharding) for x in state.codel]),
+        now_ms=jax.device_put(state.now_ms, scalar))

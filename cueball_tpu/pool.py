@@ -662,6 +662,27 @@ class ConnectionPool(FSM):
 
     addConnection = add_connection
 
+    def print_connections(self) -> dict:
+        """Debug dump of per-backend slot states
+        (reference lib/pool.js:812-832); returns the structure it
+        prints."""
+        obj: dict = {'connections': {}, 'dead': dict(self.p_dead)}
+        ks = list(self.p_keys)
+        for k in self.p_connections.keys():
+            if k not in ks:
+                ks.append(k)
+        for k in ks:
+            counts: dict[str, int] = {}
+            for fsm in self.p_connections.get(k) or []:
+                s = fsm.get_state()
+                counts[s] = counts.get(s, 0) + 1
+            obj['connections'][k] = counts
+        print('live:', obj['connections'])
+        print('dead:', obj['dead'])
+        return obj
+
+    printConnections = print_connections
+
     # -- stats -----------------------------------------------------------
 
     def get_stats(self) -> dict:

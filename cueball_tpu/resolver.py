@@ -145,8 +145,10 @@ class ResolverFSM(FSM):
         S.on(self, 'startAsserted', lambda: S.gotoState('starting'))
 
     def state_starting(self, S):
-        self.r_fsm.start()
-
+        # Listener registered before start(): the reference relies on
+        # inner resolvers deferring their 'updated' emission
+        # (lib/resolver.js:113-116 starts first), but an inner that
+        # emits synchronously from start() must not be missed.
         def on_updated(err=None):
             if err:
                 self.r_last_error = err
@@ -155,6 +157,7 @@ class ResolverFSM(FSM):
                 S.gotoState('running')
         S.on(self.r_fsm, 'updated', on_updated)
         S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        self.r_fsm.start()
 
     def state_running(self, S):
         S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))

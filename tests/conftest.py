@@ -11,11 +11,23 @@ import asyncio
 import os
 import sys
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force tests onto a virtual 8-device CPU mesh even when a real TPU is
+# attached (bench.py is what runs on the chip; tests must be hermetic).
+# The env var alone is not enough here: the container's sitecustomize
+# registers the TPU backend at interpreter startup, so override via
+# jax.config before any backend initializes.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 _xf = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _xf:
     os.environ['XLA_FLAGS'] = (
         _xf + ' --xla_force_host_platform_device_count=8').strip()
+try:
+    import jax as _jax
+    _jax.config.update('jax_platforms', 'cpu')
+except ImportError:  # pragma: no cover
+    pass
+except RuntimeError:  # pragma: no cover - backends already initialized
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,328 @@
+"""HttpAgent integration tests over real localhost servers (ported from
+reference test/agent.test.js): basic pooling, initialDomains, pinger,
+failover with a static resolver, connection-refused fast-fail, RST-ing
+server, HTTPS with a self-signed cert."""
+
+import asyncio
+import os
+import ssl
+import subprocess
+import tempfile
+import time
+
+import pytest
+
+from cueball_tpu.agent import HttpAgent, HttpsAgent
+from cueball_tpu import errors as mod_errors
+
+from conftest import run_async, settle
+
+
+RECOVERY = {'default': {'timeout': 2000, 'retries': 2, 'delay': 100,
+                        'maxDelay': 1000}}
+FAST_RECOVERY = {'default': {'timeout': 100, 'retries': 2, 'delay': 50}}
+
+
+class MiniHttpServer:
+    """Tiny asyncio HTTP/1.1 server with per-path handlers and request
+    counting."""
+
+    def __init__(self, port=0):
+        self.port = port
+        self.server = None
+        self.requests = []
+        self.ping_count = 0
+        self.fail_pings = False
+        self._writers = set()
+
+    async def start(self, ssl_ctx=None):
+        self.server = await asyncio.start_server(
+            self._handle, '127.0.0.1', self.port, ssl=ssl_ctx)
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def _handle(self, reader, writer):
+        self._writers.add(writer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b'\r\n', b'\n'):
+                    if not line:
+                        break
+                    continue
+                method, path, _ = line.decode().split(' ', 2)
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b'\r\n', b'\n', b''):
+                        break
+                    k, _, v = h.decode().partition(':')
+                    headers[k.strip().lower()] = v.strip()
+                clen = int(headers.get('content-length', 0))
+                body = await reader.readexactly(clen) if clen else b''
+                self.requests.append((method, path))
+                if path == '/ping':
+                    self.ping_count += 1
+                    if self.fail_pings:
+                        payload = b'oops'
+                        writer.write(
+                            b'HTTP/1.1 503 Service Unavailable\r\n'
+                            b'Content-Length: %d\r\n\r\n%s' % (
+                                len(payload), payload))
+                    else:
+                        writer.write(
+                            b'HTTP/1.1 200 OK\r\n'
+                            b'Content-Length: 2\r\n\r\nok')
+                else:
+                    payload = b'hello from %d' % self.port
+                    writer.write(
+                        b'HTTP/1.1 200 OK\r\nContent-Length: %d\r\n'
+                        b'\r\n%s' % (len(payload), payload))
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    def close(self):
+        """Stop listening AND sever established connections (the
+        reference's failover test kills live sockets too)."""
+        self.server.close()
+        for w in list(self._writers):
+            w.close()
+
+
+def test_basic_pooling_and_reuse():
+    async def t():
+        srv = await MiniHttpServer().start()
+        agent = HttpAgent({'defaultPort': srv.port, 'spares': 2,
+                           'maximum': 4, 'recovery': RECOVERY})
+        resp = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/', port=srv.port), 5)
+        assert resp.status == 200
+        assert resp.body == b'hello from %d' % srv.port
+
+        # Several sequential requests ride pooled keep-alive conns.
+        for _ in range(5):
+            r = await asyncio.wait_for(
+                agent.request('GET', '127.0.0.1', '/'), 5)
+            assert r.status == 200
+        pool = agent.get_pool('127.0.0.1')
+        assert pool is not None
+        stats = pool.get_stats()
+        # busy(1) + spares(2) = 3 max under sequential load; crucially
+        # NOT one connection per request.
+        assert stats['totalConnections'] <= 3
+        await agent.stop()
+        assert agent.is_stopped()
+        srv.close()
+    run_async(t())
+
+
+def test_initial_domains_precreate_pool():
+    async def t():
+        srv = await MiniHttpServer().start()
+        agent = HttpAgent({'defaultPort': srv.port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY,
+                           'initialDomains': ['127.0.0.1']})
+        assert agent.get_pool('127.0.0.1') is not None
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/x'), 5)
+        assert r.status == 200
+        await agent.stop()
+        srv.close()
+    run_async(t())
+
+
+def test_pinger_actually_pings():
+    async def t():
+        srv = await MiniHttpServer().start()
+        agent = HttpAgent({'defaultPort': srv.port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY,
+                           'ping': '/ping', 'pingInterval': 100})
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/'), 5)
+        assert r.status == 200
+        await asyncio.sleep(0.6)
+        assert srv.ping_count >= 2, \
+            'pinger should have hit /ping (got %d)' % srv.ping_count
+        await agent.stop()
+        srv.close()
+    run_async(t())
+
+
+def test_pinger_5xx_closes_connection():
+    async def t():
+        srv = await MiniHttpServer().start()
+        agent = HttpAgent({'defaultPort': srv.port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY,
+                           'ping': '/ping', 'pingInterval': 100})
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/'), 5)
+        assert r.status == 200
+        srv.fail_pings = True
+        await asyncio.sleep(0.5)
+        # 5xx pings keep closing conns; pool churns but stays alive and
+        # the next request still works once pings pass again.
+        srv.fail_pings = False
+        r2 = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/'), 5)
+        assert r2.status == 200
+        await agent.stop()
+        srv.close()
+    run_async(t())
+
+
+def test_failover_between_backends():
+    async def t():
+        srv1 = await MiniHttpServer().start()
+        srv2 = await MiniHttpServer().start()
+        from cueball_tpu.resolver import StaticIpResolver
+        resolver = StaticIpResolver({'backends': [
+            {'address': '127.0.0.1', 'port': srv1.port},
+            {'address': '127.0.0.1', 'port': srv2.port},
+        ]})
+        agent = HttpAgent({'defaultPort': srv1.port, 'spares': 2,
+                           'maximum': 4, 'recovery': RECOVERY})
+        # Wire the custom resolver through a manual pool.
+        from cueball_tpu.pool import ConnectionPool
+        pool = ConnectionPool({
+            'domain': 'svc.local', 'resolver': resolver,
+            'constructor': agent._make_socket('svc.local'),
+            'spares': 2, 'maximum': 4, 'recovery': RECOVERY})
+        agent.pools['svc.local'] = pool
+        agent.pool_resolvers['svc.local'] = resolver
+        resolver.start()
+
+        seen = set()
+        for _ in range(8):
+            r = await asyncio.wait_for(
+                agent.request('GET', 'svc.local', '/'), 5)
+            assert r.status == 200
+            seen.add(r.body)
+        assert len(seen) == 2, 'requests should spread over backends'
+
+        # Kill srv1: requests keep succeeding via srv2.
+        srv1.close()
+        await asyncio.sleep(0.1)
+        for _ in range(4):
+            r = await asyncio.wait_for(
+                agent.request('GET', 'svc.local', '/'), 5)
+            assert r.status == 200
+            assert r.body == b'hello from %d' % srv2.port
+        await agent.stop()
+        srv2.close()
+    run_async(t())
+
+
+def test_connection_refused_fast_fail():
+    async def t():
+        # Nothing listens on this port; with recovery
+        # {timeout:100, retries:2, delay:50} the first request must fail
+        # in < 1s (reference test/agent.test.js:297-318, BASELINE.md).
+        agent = HttpAgent({'defaultPort': 1, 'spares': 1, 'maximum': 2,
+                           'recovery': FAST_RECOVERY})
+        t0 = time.monotonic()
+        with pytest.raises(Exception):
+            await asyncio.wait_for(
+                agent.request('GET', '127.0.0.1', '/', port=1,
+                              timeout=800), 5)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.0, 'fast-fail took %.2fs' % elapsed
+        await agent.stop()
+    run_async(t())
+
+
+def test_server_resets_connections():
+    async def t():
+        # A raw TCP server that accepts and destroys connections after
+        # 50ms (reference test/agent.test.js:284-295,330).
+        async def rst_handler(reader, writer):
+            await asyncio.sleep(0.05)
+            sock = writer.get_extra_info('socket')
+            import socket as s
+            sock.setsockopt(s.SOL_SOCKET, s.SO_LINGER,
+                            __import__('struct').pack('ii', 1, 0))
+            writer.close()
+        rst_srv = await asyncio.start_server(
+            rst_handler, '127.0.0.1', 0)
+        port = rst_srv.sockets[0].getsockname()[1]
+
+        agent = HttpAgent({'defaultPort': port, 'spares': 1,
+                           'maximum': 2, 'recovery': FAST_RECOVERY})
+        with pytest.raises(Exception):
+            await asyncio.wait_for(
+                agent.request('GET', '127.0.0.1', '/', timeout=1500), 5)
+        await agent.stop()
+        rst_srv.close()
+    run_async(t())
+
+
+def _make_self_signed():
+    d = tempfile.mkdtemp()
+    key = os.path.join(d, 'key.pem')
+    cert = os.path.join(d, 'cert.pem')
+    subprocess.run(
+        ['openssl', 'req', '-x509', '-newkey', 'rsa:2048', '-nodes',
+         '-keyout', key, '-out', cert, '-days', '2',
+         '-subj', '/CN=127.0.0.1',
+         '-addext', 'subjectAltName=IP:127.0.0.1'],
+        check=True, capture_output=True)
+    return key, cert
+
+
+def test_https_self_signed():
+    async def t():
+        key, cert = _make_self_signed()
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        srv = await MiniHttpServer().start(ssl_ctx=ctx)
+
+        agent = HttpsAgent({'defaultPort': srv.port, 'spares': 1,
+                            'maximum': 2, 'recovery': RECOVERY,
+                            'ca': open(cert).read()})
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/secure'), 10)
+        assert r.status == 200
+        assert r.body.startswith(b'hello from')
+        await agent.stop()
+        srv.close()
+    run_async(t())
+
+
+def test_create_pool_duplicate_raises():
+    async def t():
+        srv = await MiniHttpServer().start()
+        agent = HttpAgent({'defaultPort': srv.port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY})
+        agent.create_pool('127.0.0.1')
+        with pytest.raises(RuntimeError, match='already has one'):
+            agent.create_pool('127.0.0.1')
+        await agent.stop()
+        srv.close()
+    run_async(t())
+
+
+def test_truncated_chunked_response_raises():
+    async def t():
+        async def bad_handler(reader, writer):
+            await reader.readline()
+            while (await reader.readline()) not in (b'\r\n', b'\n', b''):
+                pass
+            # Chunked response cut off mid-stream.
+            writer.write(b'HTTP/1.1 200 OK\r\n'
+                         b'Transfer-Encoding: chunked\r\n\r\n'
+                         b'5\r\nhello\r\n')
+            await writer.drain()
+            writer.close()
+        srv = await asyncio.start_server(bad_handler, '127.0.0.1', 0)
+        port = srv.sockets[0].getsockname()[1]
+        agent = HttpAgent({'defaultPort': port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY})
+        with pytest.raises(ConnectionResetError):
+            await asyncio.wait_for(
+                agent.request('GET', '127.0.0.1', '/'), 5)
+        await agent.stop()
+        srv.close()
+    run_async(t())

@@ -1,0 +1,154 @@
+"""Pool-monitor / kang tests over real HTTP (ported from reference
+test/monitor.test.js): empty registry, pool appears with per-state
+connection counts, set appears, dns resolver appears, teardown."""
+
+import asyncio
+import json
+
+from cueball_tpu.http_server import serve_monitor
+from cueball_tpu.monitor import pool_monitor
+from cueball_tpu import metrics as mod_metrics
+
+from conftest import run_async, settle, wait_for_state
+from test_pool import Ctx, make_pool
+from test_cset import make_cset
+
+
+async def _get(port, path):
+    reader, writer = await asyncio.open_connection('127.0.0.1', port)
+    writer.write(b'GET %s HTTP/1.1\r\nHost: x\r\n\r\n' %
+                 path.encode())
+    await writer.drain()
+    status_line = await reader.readline()
+    status = int(status_line.split()[1])
+    headers = {}
+    while True:
+        line = await reader.readline()
+        if line in (b'\r\n', b'\n', b''):
+            break
+        k, _, v = line.decode().partition(':')
+        headers[k.strip().lower()] = v.strip()
+    body = await reader.readexactly(int(headers['content-length']))
+    writer.close()
+    return status, json.loads(body) if \
+        headers.get('content-type', '').startswith('application/json') \
+        else body.decode()
+
+
+def test_kang_snapshot_lifecycle():
+    async def t():
+        server = await serve_monitor()
+        port = server.sockets[0].getsockname()[1]
+
+        # Types listing.
+        status, types = await _get(port, '/kang/types')
+        assert status == 200
+        assert types == ['pool', 'set', 'dns_res']
+
+        # A pool appears with per-state connection counts.
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in ctx.connections:
+            c.connect()
+        await asyncio.sleep(0.05)
+
+        status, ids = await _get(port, '/kang/objects/pool')
+        assert status == 200
+        assert pool.p_uuid in ids
+
+        status, obj = await _get(port, '/kang/obj/pool/%s' % pool.p_uuid)
+        assert status == 200
+        assert obj['state'] == 'running'
+        assert obj['connections']['b1'] == {'idle': 2}
+        assert obj['dead_backends'] == []
+        assert obj['options']['spares'] == 2
+        assert obj['options']['maximum'] == 2
+
+        # A set appears too.
+        ctx2 = Ctx()
+        cset, inner2, resolver2 = make_cset(ctx2, target=1, maximum=2)
+        cset.on('added', lambda *a: None)
+        cset.on('removed', lambda k, conn, hdl: hdl.release())
+        inner2.emit('added', 'bX', {})
+        await settle()
+        for c in ctx2.connections:
+            c.connect()
+        await asyncio.sleep(0.05)
+
+        status, ids = await _get(port, '/kang/objects/set')
+        assert cset.cs_uuid in ids
+        status, obj = await _get(port, '/kang/obj/set/%s' % cset.cs_uuid)
+        assert obj['state'] == 'running'
+        assert list(obj['fsms'].values())[0] == {'busy': 1}
+        assert obj['target'] == 1
+
+        # Full snapshot includes both.
+        status, snap = await _get(port, '/kang/snapshot')
+        assert pool.p_uuid in snap['types']['pool']
+        assert cset.cs_uuid in snap['types']['set']
+
+        # Teardown unregisters.
+        pool.stop()
+        cset.stop()
+        resolver2.stop()
+        await wait_for_state(pool, 'stopped')
+        await wait_for_state(cset, 'stopped')
+        status, ids = await _get(port, '/kang/objects/pool')
+        assert pool.p_uuid not in ids
+        status, ids = await _get(port, '/kang/objects/set')
+        assert cset.cs_uuid not in ids
+
+        # Unknown type is a clean 404.
+        status, _ = await _get(port, '/kang/objects/bogus')
+        assert status == 404
+
+        server.close()
+    run_async(t())
+
+
+def test_metrics_endpoint():
+    async def t():
+        coll = mod_metrics.create_collector({'component': 'cueball'})
+        c = coll.counter('cueball_events', help='Total cueball events')
+        c.increment({'evt': 'claim-timeout'})
+        server = await serve_monitor(collector=coll)
+        port = server.sockets[0].getsockname()[1]
+        status, text = await _get(port, '/metrics')
+        assert status == 200
+        assert '# TYPE cueball_events counter' in text
+        assert 'evt="claim-timeout"' in text
+        server.close()
+    run_async(t())
+
+
+def test_dns_resolver_registered():
+    async def t():
+        from cueball_tpu.dns_resolver import DNSResolver
+        from cueball_tpu import dns_resolver as mod_dns
+        import sys
+        sys.path.insert(0, 'tests')
+        from fake_dns import FakeDnsClient
+        orig = mod_dns.have_global_v6
+        mod_dns.have_global_v6 = lambda: False
+        try:
+            res = DNSResolver({
+                'domain': 'a.ok', 'service': '_foo._tcp',
+                'resolvers': ['1.2.3.4'],
+                'recovery': {'default': {'timeout': 1000, 'retries': 2,
+                                         'delay': 100}},
+                'dnsClient': FakeDnsClient()})
+            res.start()
+            await wait_for_state(res, 'running')
+            inner = res.r_fsm
+            obj = pool_monitor.get_dns_resolver(inner.r_uuid)
+            assert obj['domain'] == 'a.ok'
+            assert obj['state'] == 'sleep'
+            assert 'srv' in obj['next']
+            assert len(obj['backends']) == 1
+            res.stop()
+            await wait_for_state(res, 'stopped')
+        finally:
+            mod_dns.have_global_v6 = orig
+    run_async(t())
