@@ -35,7 +35,12 @@ import typing
 
 from . import errors as mod_errors
 from . import utils as mod_utils
+from .events import _native
 from .fsm import FSM
+
+# FSM state-handle gates are framework-internal listeners; the native
+# Gate type carries no attributes, so recognize it by type.
+_GATE_TYPE = _native.Gate if _native is not None else None
 
 
 def _assert_obj(v, name):
@@ -47,17 +52,46 @@ def count_listeners(emitter, event: str) -> int:
     """Count user-attached listeners, ignoring the framework's own
     (reference lib/connection-fsm.js:786-808 filters by function name; we
     mark internal handlers with a `_cueball_internal` attribute)."""
-    ls = emitter.listeners(event)
-    return len([h for h in ls
-                if callable(h) and
-                not getattr(h, '_cueball_internal', False) and
-                not getattr(getattr(h, '__wrapped_listener__', None),
-                            '_cueball_internal', False)])
+    try:
+        ls = emitter._ee_listeners.get(event, ())
+    except AttributeError:
+        ls = emitter.listeners(event)
+    n = 0
+    for h in ls:
+        if not callable(h) or getattr(h, '_cueball_internal', False):
+            continue
+        if _GATE_TYPE is not None and type(h) is _GATE_TYPE:
+            continue
+        w = getattr(h, '__wrapped_listener__', None)
+        if w is not None:
+            if getattr(w, '_cueball_internal', False):
+                continue
+            if _GATE_TYPE is not None and type(w) is _GATE_TYPE:
+                continue
+        n += 1
+    return n
 
 
 def _internal(fn):
     fn._cueball_internal = True
     return fn
+
+
+_STACK_PARSE_CACHE: dict[int, tuple[str, list]] = {}
+
+
+def _parse_stack(stack: str) -> list:
+    """Parse a formatted stack into stripped frame lines. Stack capture
+    is off by default (reference lib/utils.js:52-58), so every claim
+    passes the same placeholder string — cache its parse by identity."""
+    cached = _STACK_PARSE_CACHE.get(id(stack))
+    if cached is not None and cached[0] is stack:
+        return list(cached[1])
+    parsed = [l.strip().removeprefix('at ')
+              for l in stack.split('\n')[1:]]
+    if len(_STACK_PARSE_CACHE) < 8:
+        _STACK_PARSE_CACHE[id(stack)] = (stack, parsed)
+    return list(parsed)
 
 
 # ---------------------------------------------------------------------------
@@ -328,9 +362,7 @@ class CueBallClaimHandle(FSM):
         claim_stack = options['claimStack']
         if not isinstance(claim_stack, str):
             raise AssertionError('options.claimStack must be a string')
-        self.ch_claim_stack = [
-            l.strip().removeprefix('at ')
-            for l in claim_stack.split('\n')[1:]]
+        self.ch_claim_stack = _parse_stack(claim_stack)
 
         callback = options['callback']
         if not callable(callback):
@@ -436,9 +468,7 @@ class CueBallClaimHandle(FSM):
                 'ClaimHandle.release() called while in state "%s"' %
                 self.get_state())
         e = mod_utils.maybe_capture_stack_trace()
-        self.ch_release_stack = [
-            l.strip().removeprefix('at ')
-            for l in e['stack'].split('\n')[1:]]
+        self.ch_release_stack = _parse_stack(e['stack'])
         self.emit(event)
 
     def release(self) -> None:

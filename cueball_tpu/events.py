@@ -10,11 +10,22 @@ emission is synchronous, scheduling is the caller's concern.
 
 from __future__ import annotations
 
+import os
 import typing
 
+try:
+    if os.environ.get('CUEBALL_NO_NATIVE'):
+        _native = None
+    else:
+        from . import _cueball_native as _native
+except ImportError:
+    _native = None
 
-class EventEmitter:
-    """Node-style event emitter with synchronous delivery."""
+
+class PyEventEmitter:
+    """Node-style event emitter with synchronous delivery (pure-Python
+    reference implementation; the C core in native/emitter.c mirrors
+    these semantics exactly and replaces it when built)."""
 
     def __init__(self) -> None:
         self._ee_listeners: dict[str, list] = {}
@@ -40,11 +51,17 @@ class EventEmitter:
         lst = self._ee_listeners.get(event)
         if not lst:
             return
+        # Identity scan first (the overwhelmingly common case on the
+        # claim hot path); fall back to the once()-wrapper scan.
         for i, entry in enumerate(lst):
-            if entry is listener or \
-                    getattr(entry, '__wrapped_listener__', None) is listener:
+            if entry is listener:
                 del lst[i]
                 break
+        else:
+            for i, entry in enumerate(lst):
+                if getattr(entry, '__wrapped_listener__', None) is listener:
+                    del lst[i]
+                    break
         if not lst:
             self._ee_listeners.pop(event, None)
 
@@ -77,6 +94,14 @@ class EventEmitter:
         lst = self._ee_listeners.get(event)
         if not lst:
             return False
-        for listener in list(lst):
-            listener(*args)
+        if len(lst) == 1:
+            # Fast path: a lone listener that unsubscribes mid-call has
+            # already run, so no snapshot copy is needed.
+            lst[0](*args)
+        else:
+            for listener in tuple(lst):
+                listener(*args)
         return True
+
+
+EventEmitter = PyEventEmitter if _native is None else _native.EventEmitter

@@ -35,7 +35,7 @@ from __future__ import annotations
 import asyncio
 import typing
 
-from .events import EventEmitter
+from .events import EventEmitter, _native
 
 # Module-level transition trace hooks: fn(fsm, old_state, new_state).
 # The dtrace-probe analogue (reference docs/internals.adoc:125-131):
@@ -65,24 +65,23 @@ def get_loop() -> asyncio.AbstractEventLoop:
             'running loop (e.g. inside asyncio.run())') from None
 
 
-class _Disposable:
-    __slots__ = ('dispose',)
-
-    def __init__(self, dispose: typing.Callable[[], None]):
-        self.dispose = dispose
-
-
 class StateHandle:
     """Handle passed to each state entry function.
 
     All registrations made through the handle live exactly as long as the
-    FSM remains in the state that created them.
+    FSM remains in the state that created them. Disposables are stored
+    raw — an ``(emitter, event, listener)`` tuple or a zero-arg callable
+    — to keep the per-transition listener churn cheap (this engine is
+    the claim hot path's inner loop).
     """
+
+    __slots__ = ('_fsm', '_state', '_disposables', '_valid',
+                 '_transitioned')
 
     def __init__(self, fsm: 'FSM', state: str):
         self._fsm = fsm
         self._state = state
-        self._disposables: list[_Disposable] = []
+        self._disposables: list = []
         self._valid: list[str] | None = None
         self._transitioned = False
 
@@ -91,13 +90,24 @@ class StateHandle:
     def is_current(self) -> bool:
         return self._fsm._fsm_state_handle is self
 
-    def _gate(self, cb: typing.Callable) -> typing.Callable:
-        """Wrap cb so it only runs while this state is still current."""
-        def gated(*args, **kwargs):
-            if self.is_current():
-                return cb(*args, **kwargs)
-            return None
-        return gated
+    # Gates wrap callbacks the framework registers through a StateHandle;
+    # they are never user listeners, so they must read as internal to
+    # count_listeners (the claimed-connection leak/raise checks,
+    # reference lib/connection-fsm.js:786-808).
+    if _native is None:
+        def _gate(self, cb: typing.Callable) -> typing.Callable:
+            """Wrap cb so it only runs while this state is current."""
+            def gated(*args, **kwargs):
+                if self.is_current():
+                    return cb(*args, **kwargs)
+                return None
+            gated._cueball_internal = True
+            return gated
+    else:
+        def _gate(self, cb: typing.Callable) -> typing.Callable:
+            """Wrap cb so it only runs while this state is current
+            (native Gate: no Python frame on the stale-check path)."""
+            return _native.Gate(self._fsm, self, cb)
 
     callback = _gate  # public alias, mooremachine's S.callback()
 
@@ -107,13 +117,12 @@ class StateHandle:
            cb: typing.Callable) -> None:
         gated = self._gate(cb)
         emitter.on(event, gated)
-        self._disposables.append(
-            _Disposable(lambda: emitter.remove_listener(event, gated)))
+        self._disposables.append((emitter, event, gated))
 
     def timeout(self, ms: float, cb: typing.Callable) -> object:
         loop = get_loop()
         handle = loop.call_later(ms / 1000.0, self._gate(cb))
-        self._disposables.append(_Disposable(handle.cancel))
+        self._disposables.append(handle.cancel)
         return handle
 
     def interval(self, ms: float, cb: typing.Callable) -> object:
@@ -135,13 +144,13 @@ class StateHandle:
             if state['handle'] is not None:
                 state['handle'].cancel()
 
-        self._disposables.append(_Disposable(cancel))
+        self._disposables.append(cancel)
         return state
 
     def immediate(self, cb: typing.Callable) -> object:
         loop = get_loop()
         handle = loop.call_soon(self._gate(cb))
-        self._disposables.append(_Disposable(handle.cancel))
+        self._disposables.append(handle.cancel)
         return handle
 
     # -- transitions -----------------------------------------------------
@@ -181,7 +190,10 @@ class StateHandle:
 
     def _dispose_all(self) -> None:
         for d in self._disposables:
-            d.dispose()
+            if type(d) is tuple:
+                d[0].remove_listener(d[1], d[2])
+            else:
+                d()
         self._disposables.clear()
 
 
@@ -287,9 +299,20 @@ class FSM(EventEmitter):
             self._fsm_state_handle._dispose_all()
             self._fsm_state_handle = None
 
-        entry = getattr(self, _state_method_name(state), None)
+        # Per-class cache of state-name -> unbound entry function; the
+        # string munge + getattr is measurable on the claim hot path.
+        cls = type(self)
+        cache = cls.__dict__.get('_fsm_entry_cache')
+        if cache is None:
+            cache = {}
+            cls._fsm_entry_cache = cache
+        entry = cache.get(state)
         if entry is None:
-            raise RuntimeError('%r: unknown state "%s"' % (self, state))
+            entry = getattr(cls, _state_method_name(state), None)
+            if entry is None:
+                raise RuntimeError(
+                    '%r: unknown state "%s"' % (self, state))
+            cache[state] = entry
 
         self._fsm_state = state
         self._fsm_history.append(state)
@@ -302,7 +325,7 @@ class FSM(EventEmitter):
         for tracer in _TRANSITION_TRACERS:
             tracer(self, old, state)
 
-        entry(new_handle)
+        entry(self, new_handle)
 
         # Async (setImmediate-analogue) stateChanged emission; ordering
         # across rapid transitions is preserved by call_soon FIFO.

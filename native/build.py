@@ -1,0 +1,33 @@
+"""Build the native runtime extension in place.
+
+Usage: python native/build.py
+
+Compiles native/emitter.c into cueball_tpu/_cueball_native.*.so via
+setuptools. The framework runs identically (pure Python) when the
+extension is absent or CUEBALL_NO_NATIVE=1 is set; events.py / fsm.py
+pick the native core up automatically when present.
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    os.chdir(ROOT)
+    from setuptools import Extension, setup
+    sys.argv = [sys.argv[0], 'build_ext', '--inplace']
+    setup(
+        name='cueball-tpu-native',
+        ext_modules=[Extension(
+            'cueball_tpu._cueball_native',
+            sources=['native/emitter.c'],
+            extra_compile_args=['-O2'],
+        )],
+        script_args=['build_ext', '--inplace'],
+    )
+
+
+if __name__ == '__main__':
+    main()

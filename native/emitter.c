@@ -1,0 +1,567 @@
+/*
+ * cueball_tpu._cueball_native — native runtime core.
+ *
+ * C implementation of the event-dispatch primitives on the claim hot
+ * path (SURVEY.md §3.1): the Node-style EventEmitter contract the whole
+ * framework is built on (reference lib/ uses Node's EventEmitter;
+ * semantics mirrored from cueball_tpu/events.py), the once() wrapper,
+ * and the per-state "gate" callable that the Moore FSM engine wraps
+ * around every listener (cueball_tpu/fsm.py StateHandle._gate).
+ *
+ * The pure-Python implementations remain the reference semantics and
+ * the fallback when this module is absent (see events.py / fsm.py).
+ * Behavior must match them exactly:
+ *
+ *  - on(event, listener) appends and returns listener.
+ *  - once(event, listener) registers a wrapper exposing
+ *    __wrapped_listener__; the wrapper removes itself BEFORE invoking.
+ *  - remove_listener(event, l): first identity scan, then a
+ *    __wrapped_listener__ scan; removes at most one entry; drops the
+ *    event key when its list empties.
+ *  - emit(event, *args): synchronous delivery to a snapshot of the
+ *    current listeners; returns True iff anyone was listening.
+ *  - Gate(fsm, handle, cb)(…) runs cb only while fsm._fsm_state_handle
+ *    is still `handle` (the stale-state race guard).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <structmember.h>
+
+/* ------------------------------------------------------------------ */
+/* Once wrapper                                                        */
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *emitter;   /* borrowed semantics not allowed: strong ref */
+    PyObject *event;
+    PyObject *listener;  /* exposed as __wrapped_listener__ */
+} OnceObject;
+
+static PyTypeObject Once_Type;
+
+static int
+Once_traverse(OnceObject *self, visitproc visit, void *arg)
+{
+    Py_VISIT(self->emitter);
+    Py_VISIT(self->event);
+    Py_VISIT(self->listener);
+    return 0;
+}
+
+static int
+Once_clear(OnceObject *self)
+{
+    Py_CLEAR(self->emitter);
+    Py_CLEAR(self->event);
+    Py_CLEAR(self->listener);
+    return 0;
+}
+
+static void
+Once_dealloc(OnceObject *self)
+{
+    PyObject_GC_UnTrack(self);
+    Once_clear(self);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *
+Once_call(OnceObject *self, PyObject *args, PyObject *kwargs)
+{
+    /* Remove ourselves first (matches events.py once() wrapper). */
+    PyObject *listener = self->listener;
+    if (listener == NULL) {
+        Py_RETURN_NONE;
+    }
+    Py_INCREF(listener);
+    PyObject *r = PyObject_CallMethod(self->emitter, "remove_listener",
+                                      "OO", self->event, (PyObject *)self);
+    if (r == NULL) {
+        Py_DECREF(listener);
+        return NULL;
+    }
+    Py_DECREF(r);
+    PyObject *result = PyObject_Call(listener, args, kwargs);
+    Py_DECREF(listener);
+    return result;
+}
+
+static PyMemberDef Once_members[] = {
+    {"__wrapped_listener__", T_OBJECT, offsetof(OnceObject, listener),
+     READONLY, "original listener wrapped by once()"},
+    {NULL}
+};
+
+static PyTypeObject Once_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "cueball_tpu._cueball_native._Once",
+    .tp_basicsize = sizeof(OnceObject),
+    .tp_dealloc = (destructor)Once_dealloc,
+    .tp_call = (ternaryfunc)Once_call,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = (traverseproc)Once_traverse,
+    .tp_clear = (inquiry)Once_clear,
+    .tp_members = Once_members,
+};
+
+/* ------------------------------------------------------------------ */
+/* Gate                                                                */
+
+static PyObject *str_fsm_state_handle;   /* "_fsm_state_handle" */
+static PyObject *str_wrapped_listener;   /* "__wrapped_listener__" */
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *fsm;
+    PyObject *handle;   /* the StateHandle this gate belongs to */
+    PyObject *cb;
+} GateObject;
+
+static int
+Gate_traverse(GateObject *self, visitproc visit, void *arg)
+{
+    Py_VISIT(self->fsm);
+    Py_VISIT(self->handle);
+    Py_VISIT(self->cb);
+    return 0;
+}
+
+static int
+Gate_clear(GateObject *self)
+{
+    Py_CLEAR(self->fsm);
+    Py_CLEAR(self->handle);
+    Py_CLEAR(self->cb);
+    return 0;
+}
+
+static void
+Gate_dealloc(GateObject *self)
+{
+    PyObject_GC_UnTrack(self);
+    Gate_clear(self);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static int
+Gate_init(GateObject *self, PyObject *args, PyObject *kwargs)
+{
+    PyObject *fsm, *handle, *cb;
+    if (!PyArg_ParseTuple(args, "OOO", &fsm, &handle, &cb))
+        return -1;
+    Py_INCREF(fsm);
+    Py_XSETREF(self->fsm, fsm);
+    Py_INCREF(handle);
+    Py_XSETREF(self->handle, handle);
+    Py_INCREF(cb);
+    Py_XSETREF(self->cb, cb);
+    return 0;
+}
+
+static PyObject *
+Gate_call(GateObject *self, PyObject *args, PyObject *kwargs)
+{
+    PyObject *cur = PyObject_GetAttr(self->fsm, str_fsm_state_handle);
+    if (cur == NULL)
+        return NULL;
+    int live = (cur == self->handle);
+    Py_DECREF(cur);
+    if (!live)
+        Py_RETURN_NONE;
+    return PyObject_Call(self->cb, args, kwargs);
+}
+
+static PyTypeObject Gate_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "cueball_tpu._cueball_native.Gate",
+    .tp_basicsize = sizeof(GateObject),
+    .tp_dealloc = (destructor)Gate_dealloc,
+    .tp_call = (ternaryfunc)Gate_call,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC
+        | Py_TPFLAGS_BASETYPE,
+    .tp_traverse = (traverseproc)Gate_traverse,
+    .tp_clear = (inquiry)Gate_clear,
+    .tp_init = (initproc)Gate_init,
+    .tp_new = PyType_GenericNew,
+};
+
+/* ------------------------------------------------------------------ */
+/* EventEmitter                                                        */
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *ee_listeners;  /* dict: str -> list */
+    PyObject *inst_dict;     /* instance __dict__ (tp_dictoffset) */
+} EmitterObject;
+
+static int
+Emitter_traverse(EmitterObject *self, visitproc visit, void *arg)
+{
+    Py_VISIT(self->ee_listeners);
+    Py_VISIT(self->inst_dict);
+    return 0;
+}
+
+static int
+Emitter_clear(EmitterObject *self)
+{
+    Py_CLEAR(self->ee_listeners);
+    Py_CLEAR(self->inst_dict);
+    return 0;
+}
+
+static void
+Emitter_dealloc(EmitterObject *self)
+{
+    PyObject_GC_UnTrack(self);
+    Emitter_clear(self);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *
+Emitter_new(PyTypeObject *type, PyObject *args, PyObject *kwargs)
+{
+    /* Allocate the listener table here, not in __init__: methods must
+       never see ee_listeners == NULL (an FSM subclass that forgets
+       super().__init__(), __new__ without init, copy.copy, ...). */
+    EmitterObject *self =
+        (EmitterObject *)PyType_GenericNew(type, args, kwargs);
+    if (self == NULL)
+        return NULL;
+    self->ee_listeners = PyDict_New();
+    if (self->ee_listeners == NULL) {
+        Py_DECREF(self);
+        return NULL;
+    }
+    return (PyObject *)self;
+}
+
+static int
+Emitter_init(EmitterObject *self, PyObject *args, PyObject *kwargs)
+{
+    return 0;
+}
+
+static PyObject *
+Emitter_on(EmitterObject *self, PyObject *args)
+{
+    PyObject *event, *listener;
+    if (!PyArg_ParseTuple(args, "OO", &event, &listener))
+        return NULL;
+    PyObject *lst = PyDict_GetItemWithError(self->ee_listeners, event);
+    if (lst == NULL) {
+        if (PyErr_Occurred())
+            return NULL;
+        lst = PyList_New(0);
+        if (lst == NULL)
+            return NULL;
+        if (PyDict_SetItem(self->ee_listeners, event, lst) < 0) {
+            Py_DECREF(lst);
+            return NULL;
+        }
+        Py_DECREF(lst);  /* dict holds it */
+    }
+    if (PyList_Append(lst, listener) < 0)
+        return NULL;
+    Py_INCREF(listener);
+    return listener;
+}
+
+static PyObject *
+Emitter_once(EmitterObject *self, PyObject *args)
+{
+    PyObject *event, *listener;
+    if (!PyArg_ParseTuple(args, "OO", &event, &listener))
+        return NULL;
+    OnceObject *w = PyObject_GC_New(OnceObject, &Once_Type);
+    if (w == NULL)
+        return NULL;
+    Py_INCREF(self);
+    w->emitter = (PyObject *)self;
+    Py_INCREF(event);
+    w->event = event;
+    Py_INCREF(listener);
+    w->listener = listener;
+    PyObject_GC_Track((PyObject *)w);
+
+    /* Dispatch through self.on so a subclass override (e.g. the
+       ClaimHandle misuse trap) sees once() registrations too — exact
+       parity with PyEventEmitter.once. */
+    PyObject *r = PyObject_CallMethod((PyObject *)self, "on", "OO",
+                                      event, (PyObject *)w);
+    if (r == NULL) {
+        Py_DECREF(w);
+        return NULL;
+    }
+    Py_DECREF(r);
+    return (PyObject *)w;
+}
+
+static PyObject *
+Emitter_remove_listener(EmitterObject *self, PyObject *args)
+{
+    PyObject *event, *listener;
+    if (!PyArg_ParseTuple(args, "OO", &event, &listener))
+        return NULL;
+    PyObject *lst = PyDict_GetItemWithError(self->ee_listeners, event);
+    if (lst == NULL) {
+        if (PyErr_Occurred())
+            return NULL;
+        Py_RETURN_NONE;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(lst);
+    Py_ssize_t hit = -1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (PyList_GET_ITEM(lst, i) == listener) {
+            hit = i;
+            break;
+        }
+    }
+    if (hit < 0) {
+        /* once()-wrapper scan: match on __wrapped_listener__ */
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *entry = PyList_GET_ITEM(lst, i);
+            PyObject *wrapped;
+            if (Py_TYPE(entry) == &Once_Type) {
+                wrapped = ((OnceObject *)entry)->listener;
+                if (wrapped == listener) {
+                    hit = i;
+                    break;
+                }
+            } else {
+                wrapped = PyObject_GetAttr(entry, str_wrapped_listener);
+                if (wrapped == NULL) {
+                    PyErr_Clear();
+                    continue;
+                }
+                int match = (wrapped == listener);
+                Py_DECREF(wrapped);
+                if (match) {
+                    hit = i;
+                    break;
+                }
+            }
+        }
+    }
+    if (hit >= 0) {
+        if (PyList_SetSlice(lst, hit, hit + 1, NULL) < 0)
+            return NULL;
+        if (PyList_GET_SIZE(lst) == 0) {
+            if (PyDict_DelItem(self->ee_listeners, event) < 0)
+                PyErr_Clear();
+        }
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+Emitter_remove_all_listeners(EmitterObject *self, PyObject *args)
+{
+    PyObject *event = Py_None;
+    if (!PyArg_ParseTuple(args, "|O", &event))
+        return NULL;
+    if (event == Py_None) {
+        PyDict_Clear(self->ee_listeners);
+    } else {
+        if (PyDict_DelItem(self->ee_listeners, event) < 0)
+            PyErr_Clear();
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+Emitter_listeners(EmitterObject *self, PyObject *args)
+{
+    PyObject *event;
+    if (!PyArg_ParseTuple(args, "O", &event))
+        return NULL;
+    PyObject *lst = PyDict_GetItemWithError(self->ee_listeners, event);
+    if (lst == NULL) {
+        if (PyErr_Occurred())
+            return NULL;
+        return PyList_New(0);
+    }
+    return PyList_GetSlice(lst, 0, PyList_GET_SIZE(lst));
+}
+
+static PyObject *
+Emitter_listener_count(EmitterObject *self, PyObject *args)
+{
+    PyObject *event;
+    if (!PyArg_ParseTuple(args, "O", &event))
+        return NULL;
+    PyObject *lst = PyDict_GetItemWithError(self->ee_listeners, event);
+    if (lst == NULL) {
+        if (PyErr_Occurred())
+            return NULL;
+        return PyLong_FromLong(0);
+    }
+    return PyLong_FromSsize_t(PyList_GET_SIZE(lst));
+}
+
+static PyObject *
+Emitter_event_names(EmitterObject *self, PyObject *noargs)
+{
+    PyObject *out = PyList_New(0);
+    if (out == NULL)
+        return NULL;
+    PyObject *key, *value;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(self->ee_listeners, &pos, &key, &value)) {
+        if (PyList_GET_SIZE(value) > 0) {
+            if (PyList_Append(out, key) < 0) {
+                Py_DECREF(out);
+                return NULL;
+            }
+        }
+    }
+    return out;
+}
+
+static PyObject *
+Emitter_emit(EmitterObject *self, PyObject *args)
+{
+    Py_ssize_t nargs = PyTuple_GET_SIZE(args);
+    if (nargs < 1) {
+        PyErr_SetString(PyExc_TypeError, "emit() needs an event name");
+        return NULL;
+    }
+    PyObject *event = PyTuple_GET_ITEM(args, 0);
+    PyObject *lst = PyDict_GetItemWithError(self->ee_listeners, event);
+    if (lst == NULL) {
+        if (PyErr_Occurred())
+            return NULL;
+        Py_RETURN_FALSE;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(lst);
+    if (n == 0)
+        Py_RETURN_FALSE;
+
+    PyObject *call_args = PyTuple_GetSlice(args, 1, nargs);
+    if (call_args == NULL)
+        return NULL;
+
+    if (n == 1) {
+        /* Lone listener: no snapshot needed (it already ran even if it
+           unsubscribes mid-call). */
+        PyObject *listener = PyList_GET_ITEM(lst, 0);
+        Py_INCREF(listener);
+        PyObject *r = PyObject_Call(listener, call_args, NULL);
+        Py_DECREF(listener);
+        Py_DECREF(call_args);
+        if (r == NULL)
+            return NULL;
+        Py_DECREF(r);
+        Py_RETURN_TRUE;
+    }
+
+    PyObject *snap = PyList_GetSlice(lst, 0, n);
+    if (snap == NULL) {
+        Py_DECREF(call_args);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *listener = PyList_GET_ITEM(snap, i);
+        PyObject *r = PyObject_Call(listener, call_args, NULL);
+        if (r == NULL) {
+            Py_DECREF(snap);
+            Py_DECREF(call_args);
+            return NULL;
+        }
+        Py_DECREF(r);
+    }
+    Py_DECREF(snap);
+    Py_DECREF(call_args);
+    Py_RETURN_TRUE;
+}
+
+static PyMethodDef Emitter_methods[] = {
+    {"on", (PyCFunction)Emitter_on, METH_VARARGS,
+     "Register listener; returns it."},
+    {"add_listener", (PyCFunction)Emitter_on, METH_VARARGS,
+     "Alias of on()."},
+    {"once", (PyCFunction)Emitter_once, METH_VARARGS,
+     "Register a self-removing listener; returns the wrapper."},
+    {"remove_listener", (PyCFunction)Emitter_remove_listener,
+     METH_VARARGS, "Remove one matching listener."},
+    {"remove_all_listeners", (PyCFunction)Emitter_remove_all_listeners,
+     METH_VARARGS, "Remove all listeners (for one event or all)."},
+    {"listeners", (PyCFunction)Emitter_listeners, METH_VARARGS,
+     "Snapshot list of listeners for event."},
+    {"listener_count", (PyCFunction)Emitter_listener_count, METH_VARARGS,
+     "Number of listeners for event."},
+    {"event_names", (PyCFunction)Emitter_event_names, METH_NOARGS,
+     "Events with at least one listener."},
+    {"emit", (PyCFunction)Emitter_emit, METH_VARARGS,
+     "Deliver synchronously; True iff anyone was listening."},
+    {NULL}
+};
+
+static PyMemberDef Emitter_members[] = {
+    {"_ee_listeners", T_OBJECT, offsetof(EmitterObject, ee_listeners),
+     READONLY, "internal event -> listener-list dict"},
+    {NULL}
+};
+
+static PyTypeObject Emitter_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "cueball_tpu._cueball_native.EventEmitter",
+    .tp_basicsize = sizeof(EmitterObject),
+    .tp_dealloc = (destructor)Emitter_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC
+        | Py_TPFLAGS_BASETYPE,
+    .tp_traverse = (traverseproc)Emitter_traverse,
+    .tp_clear = (inquiry)Emitter_clear,
+    .tp_methods = Emitter_methods,
+    .tp_members = Emitter_members,
+    .tp_dictoffset = offsetof(EmitterObject, inst_dict),
+    .tp_init = (initproc)Emitter_init,
+    .tp_new = Emitter_new,
+};
+
+/* ------------------------------------------------------------------ */
+/* module                                                              */
+
+static struct PyModuleDef native_module = {
+    PyModuleDef_HEAD_INIT,
+    .m_name = "cueball_tpu._cueball_native",
+    .m_doc = "Native event-dispatch core (see module header comment).",
+    .m_size = -1,
+};
+
+PyMODINIT_FUNC
+PyInit__cueball_native(void)
+{
+    str_fsm_state_handle = PyUnicode_InternFromString("_fsm_state_handle");
+    if (str_fsm_state_handle == NULL)
+        return NULL;
+    str_wrapped_listener =
+        PyUnicode_InternFromString("__wrapped_listener__");
+    if (str_wrapped_listener == NULL)
+        return NULL;
+
+    if (PyType_Ready(&Emitter_Type) < 0 ||
+        PyType_Ready(&Once_Type) < 0 ||
+        PyType_Ready(&Gate_Type) < 0)
+        return NULL;
+
+    PyObject *m = PyModule_Create(&native_module);
+    if (m == NULL)
+        return NULL;
+
+    Py_INCREF(&Emitter_Type);
+    if (PyModule_AddObject(m, "EventEmitter",
+                           (PyObject *)&Emitter_Type) < 0) {
+        Py_DECREF(&Emitter_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&Gate_Type);
+    if (PyModule_AddObject(m, "Gate", (PyObject *)&Gate_Type) < 0) {
+        Py_DECREF(&Gate_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

@@ -1,0 +1,222 @@
+"""Parity tests: the native C event core (native/emitter.c) must match
+the pure-Python reference semantics (cueball_tpu/events.py) exactly —
+both cores stay shippable, selected at import via CUEBALL_NO_NATIVE."""
+
+import pytest
+
+from cueball_tpu.events import PyEventEmitter
+
+native = pytest.importorskip('cueball_tpu._cueball_native')
+
+CORES = [PyEventEmitter, native.EventEmitter]
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_on_emit_remove(cls):
+    e = cls()
+    hits = []
+    f = e.on('x', lambda *a: hits.append(a))
+    assert e.emit('x', 1, 2) is True
+    assert e.emit('y') is False
+    assert hits == [(1, 2)]
+    e.remove_listener('x', f)
+    assert e.emit('x') is False
+    assert e.listener_count('x') == 0
+    assert e.event_names() == []
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_once_removes_before_invoking(cls):
+    e = cls()
+    counts = []
+    e.once('x', lambda: counts.append(e.listener_count('x')))
+    e.emit('x')
+    e.emit('x')
+    # wrapper removed itself before the listener ran
+    assert counts == [0]
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_once_wrapper_exposes_wrapped(cls):
+    e = cls()
+    orig = lambda: None
+    w = e.once('x', orig)
+    assert w.__wrapped_listener__ is orig
+    # removal by the ORIGINAL listener finds the wrapper
+    e.remove_listener('x', orig)
+    assert e.listener_count('x') == 0
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_remove_one_of_duplicates(cls):
+    e = cls()
+    hits = []
+    cb = lambda: hits.append(1)
+    e.on('x', cb)
+    e.on('x', cb)
+    e.remove_listener('x', cb)
+    e.emit('x')
+    assert hits == [1]
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_emit_snapshot_semantics(cls):
+    e = cls()
+    hits = []
+
+    def second():
+        hits.append('second')
+
+    def first():
+        hits.append('first')
+        e.remove_listener('x', second)
+
+    e.on('x', first)
+    e.on('x', second)
+    # second was in the snapshot when emit started: still delivered
+    e.emit('x')
+    assert hits == ['first', 'second']
+    e.emit('x')
+    assert hits == ['first', 'second', 'first']
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_remove_all_listeners(cls):
+    e = cls()
+    e.on('x', lambda: None)
+    e.on('y', lambda: None)
+    e.remove_all_listeners('x')
+    assert e.listener_count('x') == 0
+    assert e.listener_count('y') == 1
+    e.remove_all_listeners()
+    assert e.event_names() == []
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_listeners_returns_copy(cls):
+    e = cls()
+    cb = lambda: None
+    e.on('x', cb)
+    snap = e.listeners('x')
+    assert snap == [cb]
+    snap.append('junk')
+    assert e.listener_count('x') == 1
+    assert e.listeners('nope') == []
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_exception_propagates(cls):
+    e = cls()
+
+    def boom():
+        raise ValueError('boom')
+    e.on('x', boom)
+    with pytest.raises(ValueError):
+        e.emit('x')
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_subclass_with_instance_attrs(cls):
+    class Sub(cls):
+        def __init__(self):
+            super().__init__()
+            self.extra = 42
+
+        def emit(self, ev, *a):
+            return super().emit(ev, *a)
+
+    s = Sub()
+    got = []
+    s.on('e', lambda: got.append(s.extra))
+    s.send = lambda: None  # arbitrary attribute assignment must work
+    assert s.emit('e') is True
+    assert got == [42]
+    assert isinstance(s._ee_listeners, dict)
+
+
+def test_native_safe_before_init():
+    """Methods must not crash on an instance whose __init__ never ran
+    (code-review finding: NULL listener table segfaulted)."""
+    e = native.EventEmitter.__new__(native.EventEmitter)
+    assert e.emit('x') is False
+    e.on('x', lambda: None)
+    assert e.listener_count('x') == 1
+
+
+@pytest.mark.parametrize('cls', CORES)
+def test_once_dispatches_through_overridden_on(cls):
+    """once() must register via self.on so subclass misuse traps see it
+    (the CueBallClaimHandle pattern)."""
+    seen = []
+
+    class Sub(cls):
+        def on(self, event, listener):
+            seen.append(event)
+            return super().on(event, listener)
+
+    s = Sub()
+    s.once('evt', lambda: None)
+    assert seen == ['evt']
+
+
+def test_gates_are_invisible_to_count_listeners():
+    """Listeners the FSM registers through a StateHandle are framework-
+    internal: they must not defeat the claimed-connection unhandled-
+    error raise (reference lib/connection-fsm.js:697-709)."""
+    from cueball_tpu.connection_fsm import count_listeners
+    from cueball_tpu.fsm import FSM
+
+    conn = PyEventEmitter()
+
+    class M(FSM):
+        def __init__(self):
+            super().__init__('a')
+
+        def state_a(self, S):
+            S.on(conn, 'error', lambda *a: None)
+
+    M()
+    assert conn.listener_count('error') == 1
+    assert count_listeners(conn, 'error') == 0
+    # a real user listener still counts
+    conn.on('error', lambda *a: None)
+    assert count_listeners(conn, 'error') == 1
+
+
+def test_native_gate():
+    class FakeFSM:
+        pass
+
+    fsm = FakeFSM()
+    handle = object()
+    fsm._fsm_state_handle = handle
+    out = []
+    g = native.Gate(fsm, handle, lambda v: out.append(v))
+    g(1)
+    fsm._fsm_state_handle = object()  # state exited
+    g(2)
+    assert out == [1]
+
+
+def test_fsm_engine_uses_gate_semantics():
+    """A full FSM drive-through on whatever core is active: stale
+    handlers registered by an exited state must never fire."""
+    from cueball_tpu.fsm import FSM
+
+    fired = []
+
+    class M(FSM):
+        def __init__(self):
+            self.trigger = PyEventEmitter()
+            super().__init__('a')
+
+        def state_a(self, S):
+            S.on(self.trigger, 'go', lambda: fired.append('a'))
+
+        def state_b(self, S):
+            S.on(self.trigger, 'go', lambda: fired.append('b'))
+
+    m = M()
+    m._goto_state('b')
+    m.trigger.emit('go')
+    assert fired == ['b']
