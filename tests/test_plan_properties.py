@@ -1,0 +1,129 @@
+"""Property-based invariants for plan_rebalance (hypothesis).
+
+The example-based table in tests/test_utils.py pins the reference's
+exact planning decisions (reference test/utils.test.js); these
+properties pin the *invariants* that must hold for every input — the
+starvation guard, the max cap, the dead-probe rule — because the
+reference's worst planner bugs (reference CHANGES.adoc #30) were
+cap/starvation interactions on inputs nobody had tabled."""
+
+from hypothesis import given, settings, strategies as st
+
+from cueball_tpu.utils import plan_rebalance
+
+
+class Conn:
+    """Planner treats connections as opaque tokens."""
+
+    _n = 0
+
+    def __init__(self, key):
+        Conn._n += 1
+        self.key = key
+        self.id = Conn._n
+
+    def __repr__(self):
+        return '<conn %s #%d>' % (self.key, self.id)
+
+
+@st.composite
+def planner_inputs(draw):
+    n_backends = draw(st.integers(1, 8))
+    keys = ['b%d' % i for i in range(n_backends)]
+    connections = {
+        k: [Conn(k) for _ in range(draw(st.integers(0, 4)))]
+        for k in keys
+    }
+    dead = {k: True for k in keys if draw(st.booleans())}
+    target = draw(st.integers(0, 12))
+    max_ = draw(st.integers(target, 16))
+    singleton = draw(st.booleans())
+    return connections, dead, target, max_, singleton
+
+
+def apply_plan(connections, plan):
+    """Resulting {key: count} after executing the plan."""
+    counts = {k: len(v) for k, v in connections.items()}
+    removed = {id(c) for c in plan['remove']}
+    for k, conns in connections.items():
+        counts[k] -= sum(1 for c in conns if id(c) in removed)
+    for k in plan['add']:
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+@given(planner_inputs())
+@settings(max_examples=300, deadline=None)
+def test_plan_invariants(inp):
+    connections, dead, target, max_, singleton = inp
+    plan = plan_rebalance(connections, dead, target, max_, singleton)
+
+    counts = apply_plan(connections, plan)
+    total = sum(counts.values())
+    alive = [k for k in connections if k not in dead]
+
+    # 1. Never exceed the cap.
+    assert total <= max_, (plan, counts)
+
+    # 2. No negative counts (can't remove more than exist).
+    assert all(v >= 0 for v in counts.values()), (plan, counts)
+
+    # 3. Removals must be existing connection objects, each at most once.
+    seen = set()
+    all_conns = {id(c) for conns in connections.values() for c in conns}
+    for c in plan['remove']:
+        assert id(c) in all_conns
+        assert id(c) not in seen, 'connection removed twice'
+        seen.add(id(c))
+
+    # 4. Additions only for known backends.
+    assert all(k in connections for k in plan['add'])
+
+    # 5. Singleton mode: at most one connection per backend afterwards.
+    if singleton:
+        assert all(v <= 1 for v in counts.values()), (plan, counts)
+
+    # 6. Dead backends keep at most one (probe) connection in the final
+    #    layout when the planner had room to act.
+    for k in dead:
+        if k in connections and not singleton:
+            assert counts.get(k, 0) <= max(1, len(connections[k])), \
+                (k, plan, counts)
+
+    # 7. Starvation guard: if target covers all alive backends and the
+    #    cap allows it, no alive backend is left with zero connections.
+    if not singleton and alive and target >= len(connections) \
+            and max_ >= target:
+        assert all(counts.get(k, 0) >= 1 for k in alive), (plan, counts)
+
+    # 8. With no dead backends and ample cap, the plan converges to
+    #    exactly `target` total connections (singleton: min(target,
+    #    backends)).
+    if not dead:
+        want = min(target, len(connections)) if singleton else target
+        assert total == want, (plan, counts)
+
+
+@given(planner_inputs())
+@settings(max_examples=200, deadline=None)
+def test_plan_is_idempotent_at_fixpoint(inp):
+    """Applying a plan then re-planning with no dead changes must not
+    add AND remove for the same backend (no churn loops)."""
+    connections, dead, target, max_, singleton = inp
+    plan = plan_rebalance(connections, dead, target, max_, singleton)
+
+    # Execute the plan literally.
+    new_conns = {k: list(v) for k, v in connections.items()}
+    removed = {id(c) for c in plan['remove']}
+    for k in new_conns:
+        new_conns[k] = [c for c in new_conns[k]
+                        if id(c) not in removed]
+    for k in plan['add']:
+        new_conns[k].append(Conn(k))
+
+    plan2 = plan_rebalance(new_conns, dead, target, max_, singleton)
+    # A second pass may still act (dead probes capped etc.) but must
+    # never want to both add to and remove from the same backend.
+    removes_by_key = {c.key for c in plan2['remove']}
+    overlap = removes_by_key & set(plan2['add'])
+    assert not overlap, (plan2, overlap)
