@@ -442,6 +442,52 @@ class CueBallAgent(EventEmitter):
             handle.close()
         return resp
 
+    async def upgrade(self, host: str, path: str = '/',
+                      headers: dict | None = None,
+                      protocol: str = 'websocket',
+                      port: int | None = None,
+                      timeout: float | None = None):
+        """Issue an HTTP/1.1 Upgrade on a pooled connection.
+
+        The reference removes an upgraded socket from agent management
+        until it closes ('agentRemove' hold,
+        reference lib/agent.js:361-381); here, on a 101 response the
+        claimed handle is simply never released — the slot stays busy,
+        the caller owns the raw socket for the new protocol and MUST
+        call handle.close() when finished. Returns
+        (response, socket, handle) on 101; (response, None, None)
+        otherwise (connection recycled per keep-alive as usual).
+        """
+        if self.cba_stopped:
+            raise RuntimeError('agent has been stopped')
+        pool = self.pools.get(host)
+        if pool is None:
+            pool = self._add_pool(host, {'port': port})
+
+        hdrs = {'connection': 'Upgrade', 'upgrade': protocol}
+        hdrs.update({k.lower(): v for k, v in (headers or {}).items()})
+
+        claim_opts = {}
+        if timeout is not None:
+            claim_opts['timeout'] = timeout
+        if self.cba_err_on_empty is not None:
+            claim_opts['errorOnEmpty'] = self.cba_err_on_empty
+
+        handle, socket = await pool.claim(claim_opts)
+        try:
+            resp, keep_alive = await self._do_request_on(
+                'GET', host, path, hdrs, b'', socket)
+        except BaseException:
+            handle.close()
+            raise
+        if resp.status == 101:
+            return resp, socket, handle
+        if keep_alive:
+            handle.release()
+        else:
+            handle.close()
+        return resp, None, None
+
     async def get(self, host: str, path: str = '/', **kw) -> HttpResponse:
         return await self.request('GET', host, path, **kw)
 

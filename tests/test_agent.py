@@ -61,7 +61,20 @@ class MiniHttpServer:
                 clen = int(headers.get('content-length', 0))
                 body = await reader.readexactly(clen) if clen else b''
                 self.requests.append((method, path))
-                if path == '/ping':
+                if path == '/upgrade':
+                    writer.write(
+                        b'HTTP/1.1 101 Switching Protocols\r\n'
+                        b'Upgrade: echo\r\nConnection: Upgrade\r\n\r\n')
+                    await writer.drain()
+                    # speak the "echo" protocol until EOF
+                    while True:
+                        data = await reader.readline()
+                        if not data or data.strip() == b'quit':
+                            break
+                        writer.write(b'echo:' + data)
+                        await writer.drain()
+                    break
+                elif path == '/ping':
                     self.ping_count += 1
                     if self.fail_pings:
                         payload = b'oops'
@@ -323,6 +336,65 @@ def test_truncated_chunked_response_raises():
         with pytest.raises(ConnectionResetError):
             await asyncio.wait_for(
                 agent.request('GET', '127.0.0.1', '/'), 5)
+        await agent.stop()
+        srv.close()
+    run_async(t())
+
+
+def test_upgrade_detaches_socket_until_close():
+    """Upgrade parity (reference lib/agent.js:361-381 'agentRemove'):
+    on 101 the claimed socket leaves normal recycling; the caller
+    speaks the new protocol on it and close() returns the slot."""
+    async def t():
+        srv = await MiniHttpServer().start()
+        agent = HttpAgent({'defaultPort': srv.port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY})
+        resp, sock, handle = await asyncio.wait_for(
+            agent.upgrade('127.0.0.1', '/upgrade', protocol='echo'), 5)
+        assert resp.status == 101
+        assert resp.headers.get('upgrade') == 'echo'
+        assert sock is not None and handle is not None
+
+        # The new protocol runs on the raw socket.
+        sock.writer.write(b'hello-upgrade\n')
+        await sock.writer.drain()
+        line = await asyncio.wait_for(sock.reader.readline(), 5)
+        assert line == b'echo:hello-upgrade\n'
+
+        # While detached the claim must still be held (a release()
+        # regression would return the socket to the idle set while we
+        # still own the raw protocol).
+        assert handle.is_in_state('claimed')
+
+        # A normal HTTP request meanwhile must ride a DIFFERENT
+        # connection and not garble the raw-protocol socket...
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/'), 5)
+        assert r.status == 200
+
+        # ...which still speaks the upgraded protocol afterwards.
+        sock.writer.write(b'still-mine\n')
+        await sock.writer.drain()
+        line2 = await asyncio.wait_for(sock.reader.readline(), 5)
+        assert line2 == b'echo:still-mine\n'
+        handle.close()
+        await agent.stop()
+        srv.close()
+    run_async(t())
+
+
+def test_upgrade_non_101_recycles_connection():
+    async def t():
+        srv = await MiniHttpServer().start()
+        agent = HttpAgent({'defaultPort': srv.port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY})
+        resp, sock, handle = await asyncio.wait_for(
+            agent.upgrade('127.0.0.1', '/', protocol='echo'), 5)
+        assert resp.status == 200
+        assert sock is None and handle is None
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/'), 5)
+        assert r.status == 200
         await agent.stop()
         srv.close()
     run_async(t())

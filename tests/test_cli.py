@@ -1,0 +1,51 @@
+"""cbresolve CLI smoke tests (reference bin/cbresolve has no tests;
+these pin the rebuild's argument handling and static mode end-to-end,
+since the CLI is the one surface operators touch directly)."""
+
+import subprocess
+import sys
+
+import pytest
+
+REPO = __file__.rsplit('/', 2)[0]
+
+
+def run_cli(*argv, timeout=30):
+    return subprocess.run(
+        [sys.executable, '-m', 'cueball_tpu.cli', *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout)
+
+
+def test_static_mode_prints_backends():
+    r = run_cli('-S', '127.0.0.1:8080', '10.0.0.5')
+    assert r.returncode == 0, r.stderr
+    assert '127.0.0.1' in r.stdout
+    assert '8080' in r.stdout
+    assert '10.0.0.5' in r.stdout
+
+
+def test_static_mode_default_port_flag():
+    r = run_cli('-S', '-p', '555', '10.1.2.3')
+    assert r.returncode == 0, r.stderr
+    assert '555' in r.stdout
+
+
+def test_static_mode_rejects_domain():
+    r = run_cli('-S', 'not-an-ip.example.com')
+    assert r.returncode != 0
+    assert 'not an ip' in (r.stdout + r.stderr).lower()
+
+
+def test_no_args_usage():
+    r = run_cli()
+    assert r.returncode != 0
+    assert 'usage' in (r.stdout + r.stderr).lower()
+
+
+def test_dns_mode_bad_input_fails_cleanly():
+    # A well-formed flag set with an unresolvable name must exit
+    # non-zero without a traceback (DEBUG unset).
+    r = run_cli('-t', '500', 'nonexistent.invalid')
+    assert r.returncode != 0
+    out = r.stdout + r.stderr
+    assert 'Traceback' not in out

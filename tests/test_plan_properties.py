@@ -83,12 +83,11 @@ def test_plan_invariants(inp):
     if singleton:
         assert all(v <= 1 for v in counts.values()), (plan, counts)
 
-    # 6. Dead backends keep at most one (probe) connection in the final
-    #    layout when the planner had room to act.
+    # 6. Dead backends are drained to at most one (probe) connection in
+    #    the final layout (reference lib/utils.js:296-366).
     for k in dead:
-        if k in connections and not singleton:
-            assert counts.get(k, 0) <= max(1, len(connections[k])), \
-                (k, plan, counts)
+        if k in connections:
+            assert counts.get(k, 0) <= 1, (k, plan, counts)
 
     # 7. Starvation guard: if target covers all alive backends and the
     #    cap allows it, no alive backend is left with zero connections.
