@@ -2,7 +2,10 @@
 
 PYTHON ?= python3
 
-.PHONY: test check bench dryrun coverage
+.PHONY: test check bench dryrun coverage native
+
+native:
+	$(PYTHON) native/build.py
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q

@@ -139,21 +139,27 @@ async def bench_codel_tracking():
 
 
 async def bench_claim_throughput():
-    """Driver config #1: raw claim/release cycles per second."""
-    build_pool = make_fixture()
-    pool = build_pool()
-    await settle(pool)
+    """Driver config #1: raw claim/release cycles per second.
 
-    n = 0
-    t0 = time.perf_counter()
-    deadline = t0 + 3.0
-    while time.perf_counter() < deadline:
-        hdl, conn = await pool.claim({'timeout': 1000})
-        hdl.release()
-        n += 1
-    elapsed = time.perf_counter() - t0
-    pool.stop()
-    return n / elapsed
+    Best of 3 short rounds — single rounds swing with machine load."""
+    build_pool = make_fixture()
+    best = 0.0
+    for _ in range(3):
+        pool = build_pool()
+        await settle(pool)
+        n = 0
+        t0 = time.perf_counter()
+        deadline = t0 + 1.5
+        while time.perf_counter() < deadline:
+            hdl, conn = await pool.claim({'timeout': 1000})
+            hdl.release()
+            n += 1
+        elapsed = time.perf_counter() - t0
+        pool.stop()
+        while not pool.is_in_state('stopped'):
+            await asyncio.sleep(0.01)
+        best = max(best, n / elapsed)
+    return best
 
 
 def bench_telemetry_step():

@@ -245,7 +245,8 @@ class CueBallAgent(EventEmitter):
 
         self.default_port = default_port
         self.protocol = protocol + ':'
-        self.service = '_%s._tcp' % protocol
+        self.service = options.get('service') or '_%s._tcp' % protocol
+        self.cba_upgraded: set = set()
 
         self.tcp_ka_delay = options.get('tcpKeepAliveInitialDelay')
         self.pools: dict[str, ConnectionPool] = {}
@@ -339,6 +340,14 @@ class CueBallAgent(EventEmitter):
         (reference lib/agent.js:213-265)."""
         assert not self.cba_stopped, 'agent already stopped'
         self.cba_stopped = True
+        # Outstanding upgraded sockets hold their slot busy by design;
+        # a pool cannot reach 'stopped' until they close, so shutdown
+        # reclaims them (the reference never re-manages upgraded
+        # sockets at all, lib/agent.js:361-381).
+        for handle in list(self.cba_upgraded):
+            if handle.is_in_state('claimed'):
+                handle.close()
+        self.cba_upgraded.clear()
         pools = list(self.pools.values())
         resolvers = list(self.pool_resolvers.values())
         for pool in pools:
@@ -406,13 +415,10 @@ class CueBallAgent(EventEmitter):
         await socket.writer.drain()
         return await _read_response(socket.reader, method)
 
-    async def request(self, method: str, host: str, path: str = '/',
-                      headers: dict | None = None, body: bytes = b'',
-                      port: int | None = None,
-                      timeout: float | None = None) -> HttpResponse:
-        """Claim a pooled connection to `host`, run one HTTP request,
-        and release/close per keep-alive semantics (the addRequest
-        analogue, reference lib/agent.js:275-396)."""
+    async def _claim_for(self, host: str, port: int | None,
+                         timeout: float | None):
+        """Shared claim plumbing for request()/upgrade(): stopped
+        check, lazy pool creation, claim options."""
         if self.cba_stopped:
             raise RuntimeError('agent has been stopped')
         pool = self.pools.get(host)
@@ -424,8 +430,16 @@ class CueBallAgent(EventEmitter):
             claim_opts['timeout'] = timeout
         if self.cba_err_on_empty is not None:
             claim_opts['errorOnEmpty'] = self.cba_err_on_empty
+        return await pool.claim(claim_opts)
 
-        handle, socket = await pool.claim(claim_opts)
+    async def request(self, method: str, host: str, path: str = '/',
+                      headers: dict | None = None, body: bytes = b'',
+                      port: int | None = None,
+                      timeout: float | None = None) -> HttpResponse:
+        """Claim a pooled connection to `host`, run one HTTP request,
+        and release/close per keep-alive semantics (the addRequest
+        analogue, reference lib/agent.js:275-396)."""
+        handle, socket = await self._claim_for(host, port, timeout)
         try:
             resp, keep_alive = await self._do_request_on(
                 method, host, path, headers or {}, body, socket)
@@ -458,22 +472,10 @@ class CueBallAgent(EventEmitter):
         (response, socket, handle) on 101; (response, None, None)
         otherwise (connection recycled per keep-alive as usual).
         """
-        if self.cba_stopped:
-            raise RuntimeError('agent has been stopped')
-        pool = self.pools.get(host)
-        if pool is None:
-            pool = self._add_pool(host, {'port': port})
-
         hdrs = {'connection': 'Upgrade', 'upgrade': protocol}
         hdrs.update({k.lower(): v for k, v in (headers or {}).items()})
 
-        claim_opts = {}
-        if timeout is not None:
-            claim_opts['timeout'] = timeout
-        if self.cba_err_on_empty is not None:
-            claim_opts['errorOnEmpty'] = self.cba_err_on_empty
-
-        handle, socket = await pool.claim(claim_opts)
+        handle, socket = await self._claim_for(host, port, timeout)
         try:
             resp, keep_alive = await self._do_request_on(
                 'GET', host, path, hdrs, b'', socket)
@@ -481,6 +483,12 @@ class CueBallAgent(EventEmitter):
             handle.close()
             raise
         if resp.status == 101:
+            # Track the detached handle so agent.stop() can reclaim
+            # the slot if the caller never closes it.
+            self.cba_upgraded.add(handle)
+            handle.on('stateChanged',
+                      lambda st: self.cba_upgraded.discard(handle)
+                      if st in ('released', 'closed') else None)
             return resp, socket, handle
         if keep_alive:
             handle.release()

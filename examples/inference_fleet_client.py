@@ -1,0 +1,124 @@
+"""Pooled client for a fleet of equivalent inference servers.
+
+The canonical deployment this framework targets (SURVEY.md §7.1): a
+TPU-host process — a request router, data loader, or evaluation
+harness — talking over DCN to many equivalent model servers whose
+membership is listed in DNS. The pool gives you lease-based
+connection reuse, dead-backend detection with monitor probes,
+exponential backoff with jittered spread (so a thousand clients don't
+reconnect in lock-step), and CoDel shedding when the fleet saturates.
+
+Run against any HTTP fleet:
+
+    python examples/inference_fleet_client.py 127.0.0.1:8000 \
+        127.0.0.1:8001 --requests 100
+
+or point it at a DNS service name instead of IPs:
+
+    python examples/inference_fleet_client.py \
+        --domain infer.svc.example.com --service _http._tcp
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cueball_tpu.agent import HttpAgent
+from cueball_tpu.resolver import StaticIpResolver
+from cueball_tpu.pool import ConnectionPool
+
+
+RECOVERY = {
+    # One policy object per operation class; exponential timeout+delay
+    # with randomized spread decorrelate client herds.
+    'default': {'timeout': 2000, 'retries': 3, 'delay': 250,
+                'maxDelay': 5000, 'delaySpread': 0.2},
+}
+
+
+async def run_static(addrs, n_requests, target_claim_delay):
+    backends = []
+    for a in addrs:
+        host, _, port = a.partition(':')
+        backends.append({'address': host, 'port': int(port or 80)})
+    resolver = StaticIpResolver({'backends': backends})
+
+    agent = HttpAgent({'defaultPort': backends[0]['port'],
+                       'spares': 2, 'maximum': 8,
+                       'recovery': RECOVERY,
+                       'ping': '/healthz', 'pingInterval': 5000})
+
+    # Wire the custom resolver through a manually-created pool (the
+    # agent otherwise creates a DNS resolver per hostname). The ping
+    # checker must be wired explicitly on a manual pool.
+    host = 'fleet.local'
+    pool_opts = {
+        'domain': host, 'resolver': resolver,
+        'constructor': agent._make_socket(host),
+        'spares': 2, 'maximum': 8, 'recovery': RECOVERY,
+        'checker': agent._make_checker(host), 'checkTimeout': 5000,
+    }
+    if target_claim_delay is not None:
+        pool_opts['targetClaimDelay'] = target_claim_delay
+    pool = ConnectionPool(pool_opts)
+    agent.pools[host] = pool
+    agent.pool_resolvers[host] = resolver
+    resolver.start()
+
+    ok = errs = 0
+    per_backend = {}
+    for i in range(n_requests):
+        try:
+            r = await agent.request('GET', host, '/')
+            ok += 1
+            per_backend[r.body[:40]] = per_backend.get(r.body[:40], 0) + 1
+        except Exception as e:
+            errs += 1
+            print('request %d failed: %r' % (i, e))
+    print('done: %d ok, %d failed' % (ok, errs))
+    for body, count in sorted(per_backend.items()):
+        print('  %4d x %r' % (count, body))
+    print('pool stats:', pool.get_stats())
+    await agent.stop()
+
+
+async def run_dns(domain, service, n_requests):
+    agent = HttpAgent({'defaultPort': 80, 'spares': 2, 'maximum': 8,
+                       'recovery': RECOVERY, 'service': service,
+                       'resolvers': None, 'initialDomains': [domain]})
+    ok = errs = 0
+    for i in range(n_requests):
+        try:
+            await agent.request('GET', domain, '/')
+            ok += 1
+        except Exception as e:
+            errs += 1
+            print('request %d failed: %r' % (i, e))
+    print('done: %d ok, %d failed' % (ok, errs))
+    await agent.stop()
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+    p.add_argument('addrs', nargs='*', metavar='IP[:PORT]')
+    p.add_argument('--domain', help='DNS mode: service domain')
+    p.add_argument('--service', default='_http._tcp')
+    p.add_argument('--requests', type=int, default=20)
+    p.add_argument('--target-claim-delay', type=float, default=None,
+                   help='enable CoDel shedding at this sojourn (ms)')
+    args = p.parse_args()
+    if args.domain:
+        asyncio.run(run_dns(args.domain, args.service, args.requests))
+    elif args.addrs:
+        asyncio.run(run_static(args.addrs, args.requests,
+                               args.target_claim_delay))
+    else:
+        p.error('give backend IPs or --domain')
+
+
+if __name__ == '__main__':
+    main()

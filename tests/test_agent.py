@@ -398,3 +398,20 @@ def test_upgrade_non_101_recycles_connection():
         await agent.stop()
         srv.close()
     run_async(t())
+
+
+def test_stop_reclaims_outstanding_upgrade():
+    """agent.stop() must not hang while an upgraded socket is still
+    detached; shutdown force-closes the held handle."""
+    async def t():
+        srv = await MiniHttpServer().start()
+        agent = HttpAgent({'defaultPort': srv.port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY})
+        resp, sock, handle = await asyncio.wait_for(
+            agent.upgrade('127.0.0.1', '/upgrade', protocol='echo'), 5)
+        assert resp.status == 101
+        # never close the handle; stop() must reclaim it
+        await asyncio.wait_for(agent.stop(), 5)
+        assert handle.is_in_state('closed')
+        srv.close()
+    run_async(t())

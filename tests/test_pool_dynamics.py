@@ -1,0 +1,99 @@
+"""Pool load-dynamics mechanisms: decoherence reshuffle, low-pass
+shrink clamp, and option clamping (reference lib/pool.js:44-100,
+234-245, 501-519, 577-592). These run on compressed timescales by
+driving the mechanisms directly rather than waiting out the 60 s
+shuffle timer / 5 Hz sampler."""
+
+import asyncio
+
+from conftest import run_async, settle, wait_for_state
+
+from test_pool import Ctx, make_pool
+
+
+def test_reshuffle_permutes_preference_order():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=8)
+        for i in range(6):
+            inner.emit('added', 'b%d' % i,
+                       {'address': '10.0.0.%d' % i, 'port': 1})
+        await settle()
+        before = sorted(pool.p_keys)
+        assert len(before) == 6
+
+        orders = set()
+        for _ in range(12):
+            pool.reshuffle()
+            assert sorted(pool.p_keys) == before, \
+                'reshuffle must permute, not add/drop'
+            orders.add(tuple(pool.p_keys))
+        # 12 random insertions of the tail key virtually always produce
+        # at least two distinct orderings ((1/6)^11 odds otherwise).
+        assert len(orders) >= 2, 'reshuffle never changed the order'
+
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
+
+
+def test_reshuffle_single_backend_noop():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=2)
+        inner.emit('added', 'b0', {'address': '10.0.0.1', 'port': 1})
+        await settle()
+        keys = list(pool.p_keys)
+        pool.reshuffle()
+        assert pool.p_keys == keys
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
+
+
+def test_lpf_clamp_prevents_fast_shrink():
+    """With recent load high, the rebalance target must clamp to
+    ceil(lpf) instead of shrinking to busy+spares."""
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=8)
+        inner.emit('added', 'b0', {'address': '10.0.0.1', 'port': 1})
+        await settle()
+        for c in list(ctx.connections):
+            if not c.connected:
+                c.connect()
+        await settle()
+
+        # Saturate the filter's recent window as if 6 connections had
+        # been busy (the 5 Hz sampler feeds busy+spares).
+        for _ in range(200):
+            pool.p_lpf.put(6.0)
+
+        pool._rebalance()
+        assert pool.p_last_rebal_clamped is True
+        await settle()
+        for c in list(ctx.connections):
+            if not c.connected:
+                c.connect()
+        await settle()
+        # Demand is 0 busy + 1 spare, but the clamp must hold ~6 slots
+        # open instead of shrinking toward 1 (exact count can be 6±1
+        # while the 5 Hz sampler and mid-connect rebalances interleave).
+        total = sum(len(v) for v in pool.p_connections.values())
+        assert 6 <= total <= 7, \
+            'clamp should hold ~6 conns, got %d' % total
+
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
+
+
+def test_decoherence_interval_clamped_to_60s():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=2,
+                                decoherenceInterval=5)
+        assert pool.p_shuffle_timer_inst._ms >= 60 * 1000
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
