@@ -283,15 +283,17 @@ class CueBallAgent(EventEmitter):
 
     def _add_pool(self, host: str, options: dict) -> ConnectionPool:
         port = options.get('port') or self.default_port
-        resolver = resolver_for_ip_or_domain({
-            'input': '%s:%d' % (host, port),
-            'resolverConfig': {
-                'resolvers': self.resolvers,
-                'service': self.service,
-                'maxDNSConcurrency': 3,
-                'recovery': self.cba_recovery,
-                'log': self.log,
-            }})
+        resolver = options.get('resolver')
+        if resolver is None:
+            resolver = resolver_for_ip_or_domain({
+                'input': '%s:%d' % (host, port),
+                'resolverConfig': {
+                    'resolvers': self.resolvers,
+                    'service': self.service,
+                    'maxDNSConcurrency': 3,
+                    'recovery': self.cba_recovery,
+                    'log': self.log,
+                }})
         if isinstance(resolver, Exception):
             raise resolver
 
@@ -308,8 +310,11 @@ class CueBallAgent(EventEmitter):
         if self.cba_ping is not None:
             pool_opts['checker'] = self._make_checker(host)
             pool_opts['checkTimeout'] = self.cba_ping_interval or 30000
+        if options.get('targetClaimDelay') is not None:
+            pool_opts['targetClaimDelay'] = options['targetClaimDelay']
         pool = ConnectionPool(pool_opts)
-        resolver.start()
+        if resolver.is_in_state('stopped'):
+            resolver.start()
         self.pools[host] = pool
         self.pool_resolvers[host] = resolver
         return pool
@@ -344,17 +349,25 @@ class CueBallAgent(EventEmitter):
         # a pool cannot reach 'stopped' until they close, so shutdown
         # reclaims them (the reference never re-manages upgraded
         # sockets at all, lib/agent.js:361-381).
-        for handle in list(self.cba_upgraded):
-            if handle.is_in_state('claimed'):
-                handle.close()
-        self.cba_upgraded.clear()
+        def reclaim_upgraded():
+            for handle in list(self.cba_upgraded):
+                if handle.is_in_state('claimed'):
+                    handle.close()
+
+        reclaim_upgraded()
         pools = list(self.pools.values())
         resolvers = list(self.pool_resolvers.values())
         for pool in pools:
             pool.stop()
         for pool in pools:
             while not pool.is_in_state('stopped'):
+                # An upgrade() that was in flight when stop() began
+                # registers its handle only as its claim/response
+                # resolves; keep reclaiming while we wait or the pool
+                # can never reach 'stopped'.
+                reclaim_upgraded()
                 await asyncio.sleep(0.01)
+        self.cba_upgraded.clear()
         for res in resolvers:
             if not res.is_in_state('stopped'):
                 res.stop()

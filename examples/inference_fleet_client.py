@@ -29,7 +29,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from cueball_tpu.agent import HttpAgent
 from cueball_tpu.resolver import StaticIpResolver
-from cueball_tpu.pool import ConnectionPool
 
 
 RECOVERY = {
@@ -52,22 +51,13 @@ async def run_static(addrs, n_requests, target_claim_delay):
                        'recovery': RECOVERY,
                        'ping': '/healthz', 'pingInterval': 5000})
 
-    # Wire the custom resolver through a manually-created pool (the
-    # agent otherwise creates a DNS resolver per hostname). The ping
-    # checker must be wired explicitly on a manual pool.
+    # A custom resolver (here: static IPs) rides the public
+    # create_pool API; the agent wires its socket constructor and ping
+    # checker and owns the resolver's lifecycle from here on.
     host = 'fleet.local'
-    pool_opts = {
-        'domain': host, 'resolver': resolver,
-        'constructor': agent._make_socket(host),
-        'spares': 2, 'maximum': 8, 'recovery': RECOVERY,
-        'checker': agent._make_checker(host), 'checkTimeout': 5000,
-    }
-    if target_claim_delay is not None:
-        pool_opts['targetClaimDelay'] = target_claim_delay
-    pool = ConnectionPool(pool_opts)
-    agent.pools[host] = pool
-    agent.pool_resolvers[host] = resolver
-    resolver.start()
+    agent.create_pool(host, {'resolver': resolver,
+                             'targetClaimDelay': target_claim_delay})
+    pool = agent.get_pool(host)
 
     ok = errs = 0
     per_backend = {}
@@ -108,7 +98,7 @@ def main():
     p.add_argument('--domain', help='DNS mode: service domain')
     p.add_argument('--service', default='_http._tcp')
     p.add_argument('--requests', type=int, default=20)
-    p.add_argument('--target-claim-delay', type=float, default=None,
+    p.add_argument('--target-claim-delay', type=int, default=None,
                    help='enable CoDel shedding at this sojourn (ms)')
     args = p.parse_args()
     if args.domain:

@@ -415,3 +415,35 @@ def test_stop_reclaims_outstanding_upgrade():
         assert handle.is_in_state('closed')
         srv.close()
     run_async(t())
+
+
+def test_stop_racing_inflight_upgrade_does_not_hang():
+    """stop() that begins while an upgrade() is still awaiting its 101
+    must reclaim the handle that registers only after the response
+    lands (the initial reclaim scan sees an empty set)."""
+    async def t():
+        srv = await MiniHttpServer().start()
+        agent = HttpAgent({'defaultPort': srv.port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY})
+        # Warm the pool so the upgrade claim succeeds instantly and
+        # the race window is the HTTP round-trip itself.
+        r = await asyncio.wait_for(agent.request('GET', '127.0.0.1', '/'), 5)
+        assert r.status == 200
+        up_task = asyncio.ensure_future(
+            agent.upgrade('127.0.0.1', '/upgrade', protocol='echo'))
+        # Let the claim happen but (very likely) not the full response.
+        await asyncio.sleep(0)
+        stop_task = asyncio.ensure_future(agent.stop())
+        await asyncio.wait_for(stop_task, 5)
+        # The upgrade either completed and was reclaimed, or its
+        # request died when the pool stopped; both are fine — the
+        # invariant is that stop() returned.
+        try:
+            resp, sock, handle = await asyncio.wait_for(up_task, 5)
+        except (mod_errors.CueBallError, ConnectionError, OSError,
+                asyncio.IncompleteReadError):
+            handle = None  # request died when the pool stopped — fine
+        if handle is not None:
+            assert handle.is_in_state('closed')
+        srv.close()
+    run_async(t())
