@@ -327,6 +327,7 @@ class CueBallAgent(EventEmitter):
     def create_pool(self, host: str, options: dict | None = None) -> None:
         """Pre-create the pool for a host; a duplicate is an error
         (reference lib/agent.js:464-488)."""
+        assert not self.cba_stopped, 'agent has been stopped'
         if host in self.pools:
             raise RuntimeError(
                 'Attempting to create a pool for a hostname that '

@@ -199,6 +199,17 @@ class ConnectionSet(FSM):
 
         S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
 
+        # Pending-event re-check (same race as the pool's failed state):
+        # a connection that reached 'idle'/'busy' in this loop turn
+        # emitted connectedToBackend before we started listening.
+        for fsm in self.cs_fsm.values():
+            if fsm.is_in_state('idle') or fsm.is_in_state('busy'):
+                self.cs_log.info(
+                    'entered failed with a live connection already up; '
+                    'returning to running')
+                S.gotoState('running')
+                return
+
     def state_running(self, S):
         S.validTransitions(['failed', 'stopping'])
         S.on(self.cs_resolver, 'added', self.on_resolver_added)

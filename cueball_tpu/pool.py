@@ -337,6 +337,23 @@ class ConnectionPool(FSM):
 
         self._incr_counter('failed-state')
 
+        # Pending-event re-check: a sibling slot may have connected in
+        # this very loop turn — its 'connectedToBackend' fired while
+        # 'running' (which has no listener for it) just before the
+        # last-dead-backend event pushed us here. The reference only
+        # listens for FUTURE connects and can wedge in 'failed' on this
+        # interleaving; re-checking current slot state on entry designs
+        # the race out (same pattern as the slot busy-state check,
+        # reference lib/connection-fsm.js:881-889).
+        for conns in self.p_connections.values():
+            for fsm in conns:
+                if fsm.is_in_state('idle') or fsm.is_in_state('busy'):
+                    self.p_log.info(
+                        'entered failed with a live connection already '
+                        'up; returning to running')
+                    S.gotoState('running')
+                    return
+
         # Fail all outstanding waiting claims
         # (reference lib/pool.js:398-406).
         while not self.p_waiters.is_empty():
@@ -634,8 +651,17 @@ class ConnectionPool(FSM):
 
             if new_state == 'failed':
                 # No dead mark if the backend has been removed
-                # (regression #144, reference lib/pool.js:771-777).
-                if key in self.p_backends:
+                # (regression #144, reference lib/pool.js:771-777), or
+                # if a sibling slot is connected to it right now — the
+                # backend demonstrably works, and whether its 'idle'
+                # lands before or after our 'failed' must not decide
+                # the pool's fate (the reference relies on the
+                # idle-clears-dead ordering here).
+                sibling_up = any(
+                    s is not fsm and (s.is_in_state('idle') or
+                                      s.is_in_state('busy'))
+                    for s in self.p_connections.get(key, ()))
+                if key in self.p_backends and not sibling_up:
                     self.p_dead[key] = True
                 err = fsm.get_socket_mgr().get_last_error()
                 if err is not None:

@@ -335,3 +335,119 @@ def test_cset_requires_recovery_default():
                 'resolver': resolver,
             })
     run_async(t())
+
+
+def test_cset_with_error():
+    """Reference 'cset with error' (test/cset.test.js:431-530): an
+    advertised connection that dies is removed (handle released against
+    a dead conn), the sibling survives, and the set still stops clean."""
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=2, maximum=4,
+                                          retries=1)
+        added = []
+        removed = []
+        error_key = [None]
+
+        def on_added(key, conn, hdl):
+            added.append((key, conn))
+            conn.on('error', lambda e: None)  # consumer handles errors
+        cset.on('added', on_added)
+
+        def on_removed(key, conn, hdl):
+            removed.append((key, conn))
+            hdl.release()
+        cset.on('removed', on_removed)
+
+        inner.emit('added', 'b1', {})
+        inner.emit('added', 'b2', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+        assert sorted(c.backend for _, c in added) == ['b1', 'b2']
+
+        # Kill the second advertised connection.
+        error_key[0], err_conn = added[1]
+        err_conn.emit('error', RuntimeError('boom'))
+        await asyncio.sleep(0.2)
+
+        assert [k for k, _ in removed] == [error_key[0]]
+        assert removed[0][1].dead
+        # The sibling is still advertised and alive.
+        survivor = added[0][1]
+        assert survivor.connected and not survivor.dead
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
+
+
+def test_removing_last_backend_rebal():
+    """Reference 'removing last backend (rebal)' (test/cset.test.js:
+    669-790): when the preference order flips away from both advertised
+    backends, the set drains the less-preferred one immediately but
+    never drops its LAST working connection until a replacement has
+    connected."""
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=2, maximum=5,
+                                          retries=1)
+        inset = []
+        events = []
+        cset.on('added', lambda key, conn, hdl: (
+            inset.append(key), events.append(('added', conn.backend))))
+
+        def on_removed(key, conn, hdl):
+            assert key in inset
+            inset.remove(key)
+            events.append(('removed', conn.backend))
+            conn.seen = True
+            hdl.release()
+        cset.on('removed', on_removed)
+
+        for k in ('b1', 'b2', 'b3', 'b4'):
+            inner.emit('added', k, {})
+        await settle()
+        _, counts = ctx.summarize()
+        wanted = sorted(counts)        # the two most-preferred keys
+        assert len(counts) == 2 and all(v == 1 for v in counts.values())
+        index, _ = ctx.summarize()
+        for k in wanted:
+            index[k][0].connect()
+        await asyncio.sleep(0.1)
+        assert len(inset) == 2
+
+        # Flip the preference order so both advertised backends become
+        # least-preferred, and force a rebalance.
+        cset.cs_keys.reverse()
+        events.clear()
+        cset.rebalance()
+        await asyncio.sleep(0.2)
+
+        # One of the two old connections drains right away; the other
+        # (the last working one) must still be advertised.
+        assert len(inset) == 1
+        removed_backends = [b for (what, b) in events if what == 'removed']
+        assert len(removed_backends) == 1
+        index, counts = ctx.summarize()
+        # Replacements for the two newly-preferred backends are being
+        # constructed alongside the surviving old connection.
+        new_keys = [k for k in counts if k not in wanted]
+        assert len(new_keys) == 2
+
+        for k in new_keys:
+            index[k][0].connect()
+        await asyncio.sleep(0.3)
+
+        # With replacements connected, the old survivor drains too and
+        # the set converges on the two newly-preferred backends.
+        assert len(inset) == 2
+        _, counts = ctx.summarize()
+        assert sorted(counts) == sorted(new_keys)
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())

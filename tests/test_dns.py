@@ -286,3 +286,40 @@ def test_bootstrap_dynamic_resolver_mode():
         res.stop()
         await wait_for_state(res, 'stopped')
     run_async(t())
+
+
+def test_srv_only_services_expire():
+    """Reference 'SRV lookup, only services expire' (test/dns.test.js:
+    612-685): with a short SRV TTL but long-lived address records, the
+    expiry pass re-runs the SRV stage plus only the queries that have
+    no cached answer — new targets, and names that got NODATA (no
+    negative-cache TTL was provided)."""
+    async def t():
+        Cfg.srv_ttl = 1
+        res, client = make_res('srv.ok')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running')
+        assert len(backends) == 2
+        assert sorted(b['address'] for b in backends) == \
+            ['1.2.3.4', '1234:abcd::1']
+        client.history.clear()
+
+        # A third SRV target appears; SRV ttl 1s with 1.0-1.2x forward
+        # spread puts the re-query at ~1-1.2s.
+        Cfg.use_a2 = True
+        await asyncio.sleep(1.6)
+        assert len(backends) == 4
+        assert sorted(b['address'] for b in backends) == \
+            ['1.2.3.4', '1.2.3.5', '1234:abcd::1', '1234:abcd::2']
+        # Cached a.ok/aaaa.ok answers are NOT re-queried; only the new
+        # target and the un-negative-cached misses are (reference
+        # test/dns.test.js:669-674).
+        h = history(client)
+        assert h[0] == '_foo._tcp.srv.ok/SRV'
+        assert 'a2.ok/AAAA' in h and 'a2.ok/A' in h
+        assert 'a.ok/A' not in h and 'aaaa.ok/AAAA' not in h
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())

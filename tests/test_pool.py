@@ -542,3 +542,48 @@ def test_churn_rate_limit():
         pool.stop()
         await settle(40)
     run_async(t())
+
+
+def test_pool_failure_retry_race():
+    """Reference 'pool failure / retry race' (test/pool.test.js:540-611):
+    repeated connect-then-error cycles that never exhaust retries must
+    keep the pool 'running' with no lastError and a stable population
+    of exactly two connection attempts."""
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2, retries=2,
+                                timeout=500, delay=0)
+        inner.emit('added', 'b1', {})
+        await settle()
+        index, counts = ctx.summarize()
+        assert counts == {'b1': 2}
+
+        for _round in range(2):
+            index, _ = ctx.summarize()
+            index['b1'][0].connect()
+            index['b1'][0].emit('error', RuntimeError('test'))
+            index['b1'][1].connect()
+            index['b1'][1].emit('error', RuntimeError('test'))
+            await asyncio.sleep(0.1)
+            assert pool.is_in_state('running')
+            assert len(ctx.connections) == 2
+
+        # Connect successes reset the retry budget, so no slot ever
+        # exhausted retries above (reference asserts getLastError()
+        # undefined at this point, test/pool.test.js:589).
+        assert pool.get_last_error() is None
+
+        # One connection errors out entirely while its sibling connects
+        # in the same turn: the pool must end up 'running' regardless of
+        # which event it observes first.
+        index, _ = ctx.summarize()
+        index['b1'][1].emit('error', RuntimeError('test2'))
+        index['b1'][0].connect()
+        await asyncio.sleep(0.1)
+        assert pool.is_in_state('running')
+        _, counts = ctx.summarize()
+        assert counts == {'b1': 2}
+
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())

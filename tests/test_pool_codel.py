@@ -97,3 +97,45 @@ def test_timeout_option_forbidden_with_codel():
         pool.stop()
         await settle()
     run_async(t())
+
+
+def test_codel_implicit_high_timeout():
+    """Reference 'implicit high timeout' (test/codel.test.js:114-181):
+    with targetClaimDelay set and no explicit claim timeout, a claim
+    against a pool whose connections never finished connecting times
+    out at CoDel's maxIdle (10x target); once connections are up the
+    pool is immediately usable."""
+    async def t():
+        from test_pool import Ctx, make_pool
+        target = 100
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2,
+                                retries=1, timeout=target * 11,
+                                targetClaimDelay=target)
+        inner.emit('added', 'b1', {})
+        await settle()
+        assert len(ctx.connections) == 2
+        assert all(c.backend == 'b1' for c in ctx.connections)
+
+        # Connections exist but never emitted 'connect'.
+        t0 = current_millis()
+        err = None
+        try:
+            await pool.claim()
+        except mod_errors.ClaimTimeoutError as e:
+            err = e
+        waited = current_millis() - t0
+        assert err is not None and 'timed out' in str(err).lower()
+        # maxIdle = 10x target in a healthy (never-overloaded) pool.
+        assert target * 8 <= waited <= target * 14
+
+        for c in list(ctx.connections):
+            assert c.refd
+            c.connect()
+        await settle()
+        hdl, conn = await pool.claim()
+        assert conn is not None
+        hdl.release()
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
