@@ -373,6 +373,7 @@ class CueBallClaimHandle(FSM):
             'cueball.claimhandle')
 
         self.ch_slot = None
+        self.ch_waiter_node = None  # pool claim-queue node (O(1) unlink)
         self.ch_release_stack: list[str] | None = None
         self.ch_connection = None
         self.ch_pre_listeners: dict[str, int] = {}

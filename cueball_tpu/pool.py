@@ -44,6 +44,18 @@ from .fsm import FSM, get_loop
 LP_RATE = 5
 LP_INT = round(1000 / LP_RATE)
 
+# CoDel pacer cadence (ms). Classic CoDel evaluates its control law at
+# every dequeue of a busy queue; a connection pool dequeues only when a
+# connection is released, so with long checkout holds the drop decisions
+# quantize onto the release cadence (plus the 100 ms re-arm interval)
+# and the achieved claim sojourn sits well above targetClaimDelay. While
+# the service process is demonstrably live, the pacer runs a shave-mode
+# law between dequeues: CoDel's entry condition (head above target for a
+# full control interval), then shed every above-target waiter per tick,
+# with hysteretic exit. ControlledDelay itself is untouched and still
+# consulted at dequeue sites. See docs/internals.md (CoDel section).
+CODEL_PACE = 10
+
 
 def gen_taps(count: int, tc: float) -> list[float]:
     """Generate normalized EMA filter taps (reference lib/pool.js:50-76).
@@ -181,6 +193,16 @@ class ConnectionPool(FSM):
         tcd = options.get('targetClaimDelay')
         if isinstance(tcd, (int, float)) and math.isfinite(tcd):
             self.p_codel = mod_codel.ControlledDelay(tcd)
+        # Continuous-evaluation pacer state (see CODEL_PACE above): armed
+        # while a standing queue exists; drops only while a dequeue has
+        # happened within the last control interval, so a fully stalled
+        # pool keeps the reference's shed-at-dequeue/getMaxIdle-bound
+        # behaviour (reference lib/codel.js:96-118).
+        self.p_codel_pacer = None
+        self.p_last_dequeue = -math.inf
+        self.p_pace_shaving = False
+        self.p_pace_above_since = 0.0
+        self.p_pace_below_since = 0.0
 
         self.p_last_error = None
         self.p_counters: dict[str, int] = {}
@@ -246,6 +268,87 @@ class ConnectionPool(FSM):
     def _hwm_counter(self, counter: str, val: int) -> None:
         if self.p_counters.get(counter, -math.inf) < val:
             self.p_counters[counter] = val
+
+    # -- CoDel pacer -----------------------------------------------------
+    #
+    # Entry mirrors CoDel: shave mode engages only after the queue head
+    # has sat above targetClaimDelay continuously for a full control
+    # interval (burst tolerance preserved). While engaged, every tick
+    # sheds the waiters whose sojourn exceeds the target, pinning head
+    # sojourn at ~target instead of letting it ride the release cadence.
+    # Exit is hysteretic: mode disengages only after no waiter has
+    # crossed the target for a full interval (under sustained overload
+    # fresh waiters cross constantly, so it stays engaged). The
+    # reference's ControlledDelay at the dequeue sites is untouched; in
+    # shave mode it simply stops seeing above-target sojourns and serves
+    # instead of dropping.
+
+    def _arm_codel_pacer(self) -> None:
+        if self.p_codel is None or self.p_codel_pacer is not None:
+            return
+        self.p_codel_pacer = get_loop().call_later(
+            CODEL_PACE / 1000.0, self._codel_pace)
+
+    def _pace_reset(self) -> None:
+        """Forget the shave-mode episode clocks so the next overload
+        episode gets full CoDel burst tolerance (the analogue of
+        ControlledDelay.empty() resetting cd_first_above_time)."""
+        self.p_pace_shaving = False
+        self.p_pace_above_since = 0.0
+        self.p_pace_below_since = 0.0
+
+    def _codel_pace(self) -> None:
+        self.p_codel_pacer = None
+        if self.p_codel is None or \
+                self.is_in_state('stopping') or self.is_in_state('stopped'):
+            return
+        # Resolved handles unlink themselves from p_waiters via the
+        # claim_cb waiting_listener, so the queue only holds live
+        # waiters here (modulo same-tick races handled below).
+        if len(self.p_waiters) == 0:
+            self._pace_reset()
+            return
+        now = mod_utils.current_millis()
+        if now - self.p_last_dequeue > mod_codel.CODEL_INTERVAL:
+            # Service stalled: stop pacing entirely (the reference
+            # behaviour — shed at dequeue or at the getMaxIdle bound —
+            # takes over). The next dequeue or queued claim re-arms.
+            self._pace_reset()
+            return
+        target = self.p_codel.cd_targdelay
+        interval = mod_codel.CODEL_INTERVAL
+        head_over = False
+        while len(self.p_waiters) > 0:
+            hdl = self.p_waiters.peek()
+            if not hdl.is_in_state('waiting'):
+                self.p_waiters.shift()
+                continue
+            if now - hdl.ch_started <= target:
+                break
+            head_over = True
+            if self.p_pace_above_since == 0:
+                self.p_pace_above_since = now
+            if not self.p_pace_shaving and \
+                    now - self.p_pace_above_since < interval:
+                break
+            self.p_pace_shaving = True
+            self.p_waiters.shift()
+            self._incr_counter('codel-paced-drop')
+            hdl.timeout()
+        if head_over:
+            self.p_pace_below_since = 0
+        elif self.p_pace_shaving:
+            if self.p_pace_below_since == 0:
+                self.p_pace_below_since = now
+            elif now - self.p_pace_below_since >= interval:
+                self._pace_reset()
+        else:
+            self.p_pace_above_since = 0
+        if len(self.p_waiters) == 0:
+            self.p_codel.empty()
+            self._pace_reset()
+            return
+        self._arm_codel_pacer()
 
     def on_resolver_added(self, k: str, backend: dict) -> None:
         """Insert at a random position in the preference list
@@ -431,6 +534,9 @@ class ConnectionPool(FSM):
         self.p_lp_timer.cancel()
         if self.p_rate_delay_timer is not None:
             self.p_rate_delay_timer.cancel()
+        if self.p_codel_pacer is not None:
+            self.p_codel_pacer.cancel()
+            self.p_codel_pacer = None
 
     # -- public helpers --------------------------------------------------
 
@@ -625,6 +731,7 @@ class ConnectionPool(FSM):
                     fsm.set_unwanted()
                     return
 
+                self.p_last_dequeue = mod_utils.current_millis()
                 while len(self.p_waiters) > 0:
                     hdl = self.p_waiters.shift()
                     drop = self.p_codel is not None and \
@@ -634,11 +741,15 @@ class ConnectionPool(FSM):
                     if drop:
                         hdl.timeout()
                         continue
+                    # Service is live again; waiters may remain queued
+                    # behind this one, so resume pacing.
+                    self._arm_codel_pacer()
                     hdl.try_(fsm)
                     return
 
                 if self.p_codel is not None:
                     self.p_codel.empty()
+                    self._pace_reset()
 
                 fsm.p_idleq_node = self.p_idleq.push(fsm)
                 return
@@ -797,14 +908,22 @@ class ConnectionPool(FSM):
                 handle.fail(mod_errors.NoBackendsError(
                     self, self.p_resolver.get_last_error()))
 
-            self.p_waiters.push(handle)
+            handle.ch_waiter_node = self.p_waiters.push(handle)
             self._hwm_counter('max-claim-queue', len(self.p_waiters))
             self._incr_counter('queued-claim')
+            self._arm_codel_pacer()
             self.rebalance()
 
         def waiting_listener(st):
             if st == 'waiting':
                 try_next()
+            elif handle.ch_waiter_node is not None:
+                # The handle resolved (timeout/cancel/claiming) while
+                # queued: unlink its claim-queue node now, O(1), so a
+                # stalled pool never pins resolved handles until a
+                # dequeue that may not come.
+                handle.ch_waiter_node.remove()
+                handle.ch_waiter_node = None
         handle.on('stateChanged', waiting_listener)
 
         return handle

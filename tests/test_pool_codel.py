@@ -16,27 +16,34 @@ from test_pool import Ctx, make_pool
 HOLD_MS = 50          # claim hold time (reference: 50ms)
 CLAIMS_PER_TICK = 5   # 5 claims every 10ms (reference)
 TICK_MS = 10
-RUN_S = 2.0           # reference runs 5s; 2s keeps the suite quick
+RUN_S = 5.0           # reference run length (test/codel.test.js:251)
 TOLERANCE = 175       # reference asserts avg within +/-175ms of target
 
 
 async def run_load(pool):
     stats = {'ok': 0, 'timeouts': 0, 'other': 0, 'delays': []}
-    pending = []
+    pending = [0]
+    drained = asyncio.Event()
 
     def make_claim():
         start = current_millis()
+        pending[0] += 1
 
         def cb(err, hdl=None, conn=None):
+            # The reference records EVERY resolution's sojourn, not just
+            # successes (test/codel.test.js:227).
+            stats['delays'].append(current_millis() - start)
             if err is None:
                 stats['ok'] += 1
-                stats['delays'].append(current_millis() - start)
                 loop = asyncio.get_running_loop()
                 loop.call_later(HOLD_MS / 1000.0, hdl.release)
             elif isinstance(err, mod_errors.ClaimTimeoutError):
                 stats['timeouts'] += 1
             else:
                 stats['other'] += 1
+            pending[0] -= 1
+            if pending[0] == 0:
+                drained.set()
         pool.claim_cb({}, cb)
 
     loop = asyncio.get_running_loop()
@@ -45,8 +52,9 @@ async def run_load(pool):
         for _ in range(CLAIMS_PER_TICK):
             make_claim()
         await asyncio.sleep(TICK_MS / 1000.0)
-    # Let in-flight claims resolve.
-    await asyncio.sleep(1.0)
+    # Wait for the queue to fully drain (reference uses a vasync
+    # barrier keyed on every claim, test/codel.test.js:225-256).
+    await drained.wait()
     return stats
 
 
@@ -72,6 +80,9 @@ def _run_target(target):
             'avg claim delay %.1fms not within %dms of target %dms '
             '(ok=%d shed=%d)' % (avg, TOLERANCE, target, stats['ok'],
                                  stats['timeouts']))
+        # The continuous-evaluation pacer must have engaged under this
+        # sustained overload (it is what keeps the tracking tight).
+        assert pool.get_stats()['counters'].get('codel-paced-drop', 0) > 0
         pool.stop()
         await wait_for_state(pool, 'stopped')
     run_async(t(), timeout=30)
@@ -106,7 +117,6 @@ def test_codel_implicit_high_timeout():
     out at CoDel's maxIdle (10x target); once connections are up the
     pool is immediately usable."""
     async def t():
-        from test_pool import Ctx, make_pool
         target = 100
         ctx = Ctx()
         pool, inner = make_pool(ctx, spares=2, maximum=2,
@@ -136,6 +146,39 @@ def test_codel_implicit_high_timeout():
         hdl, conn = await pool.claim()
         assert conn is not None
         hdl.release()
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
+
+
+def test_pacer_disarms_and_purges_on_stalled_pool():
+    """A stalled pool (connections never connect) must not busy-tick
+    the pacer forever nor pin timed-out claim handles in the wait
+    queue; shedding is left to the reference's getMaxIdle bound."""
+    async def t():
+        target = 100
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2, retries=1,
+                                timeout=target * 12,
+                                targetClaimDelay=target)
+        inner.emit('added', 'b1', {})
+        await settle()
+        errs = []
+        for _ in range(5):
+            pool.claim_cb({}, lambda err, h=None, c=None:
+                          errs.append(err))
+        # Claims resolve at maxIdle (10x target), far above target: the
+        # pacer must not have shed them early.
+        await asyncio.sleep(target * 10 / 1000.0 + 0.5)
+        assert len(errs) == 5
+        assert all(isinstance(e, mod_errors.ClaimTimeoutError)
+                   for e in errs)
+        assert pool.get_stats()['counters'].get('codel-paced-drop',
+                                                0) == 0
+        # Resolved handles were unlinked from the wait queue and the
+        # pacer disarmed despite no dequeue ever happening.
+        assert len(pool.p_waiters) == 0
+        assert pool.p_codel_pacer is None
         pool.stop()
         await wait_for_state(pool, 'stopped')
     run_async(t())
