@@ -53,6 +53,11 @@ def count_listeners(emitter, event: str) -> int:
     (reference lib/connection-fsm.js:786-808 filters by function name; we
     mark internal handlers with a `_cueball_internal` attribute)."""
     try:
+        # Native emitters filter in C (same rules, no list copy).
+        return emitter.count_external(event)
+    except AttributeError:
+        pass
+    try:
         ls = emitter._ee_listeners.get(event, ())
     except AttributeError:
         ls = emitter.listeners(event)

@@ -65,8 +65,9 @@ def get_loop() -> asyncio.AbstractEventLoop:
             'running loop (e.g. inside asyncio.run())') from None
 
 
-class StateHandle:
-    """Handle passed to each state entry function.
+class _PyStateHandle:
+    """Handle passed to each state entry function (pure-Python
+    fallback; see the native-backed StateHandle below).
 
     All registrations made through the handle live exactly as long as the
     FSM remains in the state that created them. Disposables are stored
@@ -94,20 +95,14 @@ class StateHandle:
     # they are never user listeners, so they must read as internal to
     # count_listeners (the claimed-connection leak/raise checks,
     # reference lib/connection-fsm.js:786-808).
-    if _native is None:
-        def _gate(self, cb: typing.Callable) -> typing.Callable:
-            """Wrap cb so it only runs while this state is current."""
-            def gated(*args, **kwargs):
-                if self.is_current():
-                    return cb(*args, **kwargs)
-                return None
-            gated._cueball_internal = True
-            return gated
-    else:
-        def _gate(self, cb: typing.Callable) -> typing.Callable:
-            """Wrap cb so it only runs while this state is current
-            (native Gate: no Python frame on the stale-check path)."""
-            return _native.Gate(self._fsm, self, cb)
+    def _gate(self, cb: typing.Callable) -> typing.Callable:
+        """Wrap cb so it only runs while this state is current."""
+        def gated(*args, **kwargs):
+            if self.is_current():
+                return cb(*args, **kwargs)
+            return None
+        gated._cueball_internal = True
+        return gated
 
     callback = _gate  # public alias, mooremachine's S.callback()
 
@@ -119,39 +114,8 @@ class StateHandle:
         emitter.on(event, gated)
         self._disposables.append((emitter, event, gated))
 
-    def timeout(self, ms: float, cb: typing.Callable) -> object:
-        loop = get_loop()
-        handle = loop.call_later(ms / 1000.0, self._gate(cb))
-        self._disposables.append(handle.cancel)
-        return handle
-
-    def interval(self, ms: float, cb: typing.Callable) -> object:
-        loop = get_loop()
-        state = {'handle': None, 'cancelled': False}
-        gated = self._gate(cb)
-
-        def fire():
-            if state['cancelled'] or not self.is_current():
-                return
-            gated()
-            if not state['cancelled'] and self.is_current():
-                state['handle'] = loop.call_later(ms / 1000.0, fire)
-
-        state['handle'] = loop.call_later(ms / 1000.0, fire)
-
-        def cancel():
-            state['cancelled'] = True
-            if state['handle'] is not None:
-                state['handle'].cancel()
-
-        self._disposables.append(cancel)
-        return state
-
-    def immediate(self, cb: typing.Callable) -> object:
-        loop = get_loop()
-        handle = loop.call_soon(self._gate(cb))
-        self._disposables.append(handle.cancel)
-        return handle
+    def _add_disposable(self, d: typing.Callable) -> None:
+        self._disposables.append(d)
 
     # -- transitions -----------------------------------------------------
 
@@ -175,6 +139,57 @@ class StateHandle:
 
     gotoState = goto_state
 
+    # -- teardown --------------------------------------------------------
+
+    def _dispose_all(self) -> None:
+        for d in self._disposables:
+            if type(d) is tuple:
+                d[0].remove_listener(d[1], d[2])
+            else:
+                d()
+        self._disposables.clear()
+
+
+class _TimerRegistrationsMixin:
+    """Timer/scheduling registrations shared by both StateHandle
+    implementations, built on _gate/_add_disposable/is_current."""
+
+    __slots__ = ()
+
+    def timeout(self, ms: float, cb: typing.Callable) -> object:
+        loop = get_loop()
+        handle = loop.call_later(ms / 1000.0, self._gate(cb))
+        self._add_disposable(handle.cancel)
+        return handle
+
+    def interval(self, ms: float, cb: typing.Callable) -> object:
+        loop = get_loop()
+        state = {'handle': None, 'cancelled': False}
+        gated = self._gate(cb)
+
+        def fire():
+            if state['cancelled'] or not self.is_current():
+                return
+            gated()
+            if not state['cancelled'] and self.is_current():
+                state['handle'] = loop.call_later(ms / 1000.0, fire)
+
+        state['handle'] = loop.call_later(ms / 1000.0, fire)
+
+        def cancel():
+            state['cancelled'] = True
+            if state['handle'] is not None:
+                state['handle'].cancel()
+
+        self._add_disposable(cancel)
+        return state
+
+    def immediate(self, cb: typing.Callable) -> object:
+        loop = get_loop()
+        handle = loop.call_soon(self._gate(cb))
+        self._add_disposable(handle.cancel)
+        return handle
+
     def goto_state_on(self, emitter: EventEmitter, event: str,
                       state: str) -> None:
         self.on(emitter, event, lambda *a: self.goto_state(state))
@@ -186,15 +201,18 @@ class StateHandle:
 
     gotoStateTimeout = goto_state_timeout
 
-    # -- teardown --------------------------------------------------------
 
-    def _dispose_all(self) -> None:
-        for d in self._disposables:
-            if type(d) is tuple:
-                d[0].remove_listener(d[1], d[2])
-            else:
-                d()
-        self._disposables.clear()
+if _native is None:
+    class StateHandle(_TimerRegistrationsMixin, _PyStateHandle):
+        __slots__ = ()
+else:
+    class StateHandle(_TimerRegistrationsMixin,
+                      _native.StateHandleBase):
+        """Native-backed state handle: gate construction, listener
+        registration/disposal bookkeeping, and the stale-handle
+        transition guard run in C (native/emitter.c StateHandleBase);
+        timer registrations remain in Python via the mixin."""
+        __slots__ = ()
 
 
 def _state_method_name(state: str) -> str:
@@ -250,13 +268,18 @@ class FSM(EventEmitter):
 
     allStateEvent = all_state_event
 
-    def emit(self, event: str, *args) -> bool:
-        delivered = super().emit(event, *args)
-        if not delivered and event in self._fsm_all_state_events:
-            raise RuntimeError(
-                '%r: event "%s" (declared all-state) emitted in state '
-                '"%s" with no handler' % (self, event, self._fsm_state))
-        return delivered
+    if _native is None:
+        # With the native core, the undelivered-all-state-event crash
+        # is enforced inside EventEmitter.emit itself (emitter.c
+        # emit_check_all_state); no Python override needed.
+        def emit(self, event: str, *args) -> bool:
+            delivered = super().emit(event, *args)
+            if not delivered and event in self._fsm_all_state_events:
+                raise RuntimeError(
+                    '%r: event "%s" (declared all-state) emitted in '
+                    'state "%s" with no handler' % (
+                        self, event, self._fsm_state))
+            return delivered
 
     # -- transitions -----------------------------------------------------
 

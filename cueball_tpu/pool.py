@@ -907,6 +907,7 @@ class ConnectionPool(FSM):
             if err_on_empty and self.p_resolver.count() < 1:
                 handle.fail(mod_errors.NoBackendsError(
                     self, self.p_resolver.get_last_error()))
+                return
 
             handle.ch_waiter_node = self.p_waiters.push(handle)
             self._hwm_counter('max-claim-queue', len(self.p_waiters))

@@ -110,6 +110,13 @@ static PyTypeObject Once_Type = {
 
 static PyObject *str_fsm_state_handle;   /* "_fsm_state_handle" */
 static PyObject *str_wrapped_listener;   /* "__wrapped_listener__" */
+static PyObject *str_on;                 /* "on" */
+static PyObject *str_remove_listener;    /* "remove_listener" */
+static PyObject *str_goto_state_priv;    /* "_goto_state" */
+static PyObject *str_get_state;          /* "get_state" */
+static PyObject *str_cueball_internal;   /* "_cueball_internal" */
+static PyObject *str_all_state_events;   /* "_fsm_all_state_events" */
+static PyObject *str_fsm_state;          /* "_fsm_state" */
 
 typedef struct {
     PyObject_HEAD
@@ -183,6 +190,274 @@ static PyTypeObject Gate_Type = {
     .tp_traverse = (traverseproc)Gate_traverse,
     .tp_clear = (inquiry)Gate_clear,
     .tp_init = (initproc)Gate_init,
+    .tp_new = PyType_GenericNew,
+};
+
+/* Direct Gate construction (no tp_new/tp_init round trip) for the
+   StateHandle hot path. */
+static PyObject *
+gate_create(PyObject *fsm, PyObject *handle, PyObject *cb)
+{
+    GateObject *g = PyObject_GC_New(GateObject, &Gate_Type);
+    if (g == NULL)
+        return NULL;
+    Py_INCREF(fsm);
+    g->fsm = fsm;
+    Py_INCREF(handle);
+    g->handle = handle;
+    Py_INCREF(cb);
+    g->cb = cb;
+    PyObject_GC_Track((PyObject *)g);
+    return (PyObject *)g;
+}
+
+/* ------------------------------------------------------------------ */
+/* StateHandleBase — C core of the Moore FSM per-state handle          */
+/*                                                                     */
+/* Owns the disposables list and implements the hot registrations      */
+/* (on/_gate/_dispose_all) plus the transition guard (goto_state,      */
+/* valid_transitions). Timer-based registrations (timeout/interval/    */
+/* immediate) stay in the Python subclass (cueball_tpu/fsm.py), built  */
+/* on _gate/_add_disposable. Semantics mirror the pure-Python          */
+/* StateHandle in fsm.py exactly.                                      */
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *sh_fsm;
+    PyObject *sh_state;
+    PyObject *sh_disposables;  /* list of (emitter,event,gate) | callable */
+    PyObject *sh_valid;        /* list[str] or None */
+    char sh_transitioned;
+} SHandleObject;
+
+static PyTypeObject SHandle_Type;
+
+static int
+SHandle_traverse(SHandleObject *self, visitproc visit, void *arg)
+{
+    Py_VISIT(self->sh_fsm);
+    Py_VISIT(self->sh_state);
+    Py_VISIT(self->sh_disposables);
+    Py_VISIT(self->sh_valid);
+    return 0;
+}
+
+static int
+SHandle_clear_(SHandleObject *self)
+{
+    Py_CLEAR(self->sh_fsm);
+    Py_CLEAR(self->sh_state);
+    Py_CLEAR(self->sh_disposables);
+    Py_CLEAR(self->sh_valid);
+    return 0;
+}
+
+static void
+SHandle_dealloc(SHandleObject *self)
+{
+    PyObject_GC_UnTrack(self);
+    SHandle_clear_(self);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static int
+SHandle_init(SHandleObject *self, PyObject *args, PyObject *kwargs)
+{
+    PyObject *fsm, *state;
+    if (!PyArg_ParseTuple(args, "OO", &fsm, &state))
+        return -1;
+    Py_INCREF(fsm);
+    Py_XSETREF(self->sh_fsm, fsm);
+    Py_INCREF(state);
+    Py_XSETREF(self->sh_state, state);
+    PyObject *lst = PyList_New(0);
+    if (lst == NULL)
+        return -1;
+    Py_XSETREF(self->sh_disposables, lst);
+    Py_INCREF(Py_None);
+    Py_XSETREF(self->sh_valid, Py_None);
+    self->sh_transitioned = 0;
+    return 0;
+}
+
+static int
+shandle_is_current(SHandleObject *self)
+{
+    PyObject *cur = PyObject_GetAttr(self->sh_fsm, str_fsm_state_handle);
+    if (cur == NULL)
+        return -1;
+    int live = (cur == (PyObject *)self);
+    Py_DECREF(cur);
+    return live;
+}
+
+static PyObject *
+SHandle_is_current(SHandleObject *self, PyObject *noargs)
+{
+    int live = shandle_is_current(self);
+    if (live < 0)
+        return NULL;
+    return PyBool_FromLong(live);
+}
+
+static PyObject *
+SHandle_gate(SHandleObject *self, PyObject *cb)
+{
+    return gate_create(self->sh_fsm, (PyObject *)self, cb);
+}
+
+static PyObject *
+SHandle_on(SHandleObject *self, PyObject *args)
+{
+    PyObject *emitter, *event, *cb;
+    if (!PyArg_ParseTuple(args, "OOO", &emitter, &event, &cb))
+        return NULL;
+    PyObject *gate = gate_create(self->sh_fsm, (PyObject *)self, cb);
+    if (gate == NULL)
+        return NULL;
+    /* Method dispatch so emitter-side overrides (e.g. the ClaimHandle
+       misuse trap) see the registration. */
+    PyObject *r = PyObject_CallMethodObjArgs(emitter, str_on, event,
+                                             gate, NULL);
+    if (r == NULL) {
+        Py_DECREF(gate);
+        return NULL;
+    }
+    Py_DECREF(r);
+    PyObject *t = PyTuple_Pack(3, emitter, event, gate);
+    Py_DECREF(gate);
+    if (t == NULL)
+        return NULL;
+    int rc = PyList_Append(self->sh_disposables, t);
+    Py_DECREF(t);
+    if (rc < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+SHandle_add_disposable(SHandleObject *self, PyObject *d)
+{
+    if (PyList_Append(self->sh_disposables, d) < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+SHandle_dispose_all(SHandleObject *self, PyObject *noargs)
+{
+    PyObject *lst = self->sh_disposables;
+    Py_ssize_t n = PyList_GET_SIZE(lst);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *d = PyList_GET_ITEM(lst, i);
+        if (PyTuple_CheckExact(d) && PyTuple_GET_SIZE(d) == 3) {
+            PyObject *r = PyObject_CallMethodObjArgs(
+                PyTuple_GET_ITEM(d, 0), str_remove_listener,
+                PyTuple_GET_ITEM(d, 1), PyTuple_GET_ITEM(d, 2), NULL);
+            if (r == NULL)
+                return NULL;
+            Py_DECREF(r);
+        } else {
+            PyObject *r = PyObject_CallNoArgs(d);
+            if (r == NULL)
+                return NULL;
+            Py_DECREF(r);
+        }
+    }
+    if (PyList_SetSlice(lst, 0, PyList_GET_SIZE(lst), NULL) < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+SHandle_valid_transitions(SHandleObject *self, PyObject *states)
+{
+    PyObject *lst = PySequence_List(states);
+    if (lst == NULL)
+        return NULL;
+    Py_XSETREF(self->sh_valid, lst);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+SHandle_goto_state(SHandleObject *self, PyObject *state)
+{
+    int live = shandle_is_current(self);
+    if (live < 0)
+        return NULL;
+    if (!live || self->sh_transitioned) {
+        /* A stale handle must never move the machine; a handle that
+           already requested a transition counts as stale (matches the
+           pure-Python StateHandle.goto_state). */
+        PyObject *cur = PyObject_CallMethodNoArgs(self->sh_fsm,
+                                                  str_get_state);
+        if (cur == NULL)
+            return NULL;
+        PyErr_Format(PyExc_RuntimeError,
+                     "%S: gotoState(%S) called from stale state handle "
+                     "for state \"%S\" (now in \"%S\")",
+                     self->sh_fsm, state, self->sh_state, cur);
+        Py_DECREF(cur);
+        return NULL;
+    }
+    self->sh_transitioned = 1;
+    PyObject *r = PyObject_CallMethodObjArgs(self->sh_fsm,
+                                             str_goto_state_priv, state,
+                                             NULL);
+    if (r == NULL)
+        return NULL;
+    Py_DECREF(r);
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef SHandle_methods[] = {
+    {"is_current", (PyCFunction)SHandle_is_current, METH_NOARGS,
+     "True while this handle's state is the FSM's current state."},
+    {"_gate", (PyCFunction)SHandle_gate, METH_O,
+     "Wrap cb so it only runs while this state is current."},
+    {"callback", (PyCFunction)SHandle_gate, METH_O,
+     "Alias of _gate (mooremachine S.callback)."},
+    {"on", (PyCFunction)SHandle_on, METH_VARARGS,
+     "Register a state-scoped listener on an emitter."},
+    {"_add_disposable", (PyCFunction)SHandle_add_disposable, METH_O,
+     "Register a zero-arg teardown callable for state exit."},
+    {"_dispose_all", (PyCFunction)SHandle_dispose_all, METH_NOARGS,
+     "Tear down every registration made through this handle."},
+    {"valid_transitions", (PyCFunction)SHandle_valid_transitions, METH_O,
+     "Whitelist the states this state may transition to."},
+    {"validTransitions", (PyCFunction)SHandle_valid_transitions, METH_O,
+     "Alias of valid_transitions."},
+    {"goto_state", (PyCFunction)SHandle_goto_state, METH_O,
+     "Request a transition; raises from a stale handle."},
+    {"gotoState", (PyCFunction)SHandle_goto_state, METH_O,
+     "Alias of goto_state."},
+    {NULL}
+};
+
+static PyMemberDef SHandle_members[] = {
+    {"_fsm", T_OBJECT, offsetof(SHandleObject, sh_fsm), READONLY,
+     "owning FSM"},
+    {"_state", T_OBJECT, offsetof(SHandleObject, sh_state), READONLY,
+     "state this handle belongs to"},
+    {"_valid", T_OBJECT, offsetof(SHandleObject, sh_valid), READONLY,
+     "whitelisted exit states (None = any)"},
+    {"_transitioned", T_BOOL, offsetof(SHandleObject, sh_transitioned),
+     READONLY, "a transition has been requested via this handle"},
+    {NULL}
+};
+
+static PyTypeObject SHandle_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "cueball_tpu._cueball_native.StateHandleBase",
+    .tp_basicsize = sizeof(SHandleObject),
+    .tp_dealloc = (destructor)SHandle_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC
+        | Py_TPFLAGS_BASETYPE,
+    .tp_traverse = (traverseproc)SHandle_traverse,
+    .tp_clear = (inquiry)SHandle_clear_,
+    .tp_methods = SHandle_methods,
+    .tp_members = SHandle_members,
+    .tp_init = (initproc)SHandle_init,
     .tp_new = PyType_GenericNew,
 };
 
@@ -400,6 +675,74 @@ Emitter_listener_count(EmitterObject *self, PyObject *args)
     return PyLong_FromSsize_t(PyList_GET_SIZE(lst));
 }
 
+/* attr or NULL (missing attr cleared), like getattr(o, name, None) */
+static PyObject *
+getattr_or_null(PyObject *o, PyObject *name)
+{
+    PyObject *v = PyObject_GetAttr(o, name);
+    if (v == NULL)
+        PyErr_Clear();
+    return v;
+}
+
+static PyObject *
+Emitter_count_external(EmitterObject *self, PyObject *args)
+{
+    /* Count user-attached listeners, ignoring the framework's own
+       (Gate instances and _cueball_internal-marked handlers, including
+       through a once() __wrapped_listener__). Mirrors
+       cueball_tpu.connection_fsm.count_listeners exactly. */
+    PyObject *event;
+    if (!PyArg_ParseTuple(args, "O", &event))
+        return NULL;
+    PyObject *lst = PyDict_GetItemWithError(self->ee_listeners, event);
+    if (lst == NULL) {
+        if (PyErr_Occurred())
+            return NULL;
+        return PyLong_FromLong(0);
+    }
+    Py_ssize_t n = PyList_GET_SIZE(lst);
+    long count = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *h = PyList_GET_ITEM(lst, i);
+        if (!PyCallable_Check(h))
+            continue;
+        PyObject *v = getattr_or_null(h, str_cueball_internal);
+        if (v != NULL) {
+            int internal = PyObject_IsTrue(v);
+            Py_DECREF(v);
+            if (internal < 0)
+                return NULL;
+            if (internal)
+                continue;
+        }
+        if (Py_TYPE(h) == &Gate_Type)
+            continue;
+        PyObject *w = getattr_or_null(h, str_wrapped_listener);
+        if (w != NULL && w != Py_None) {
+            PyObject *wv = getattr_or_null(w, str_cueball_internal);
+            int skip = 0;
+            if (wv != NULL) {
+                skip = PyObject_IsTrue(wv);
+                Py_DECREF(wv);
+                if (skip < 0) {
+                    Py_DECREF(w);
+                    return NULL;
+                }
+            }
+            if (!skip && Py_TYPE(w) == &Gate_Type)
+                skip = 1;
+            Py_DECREF(w);
+            if (skip)
+                continue;
+        } else {
+            Py_XDECREF(w);
+        }
+        count++;
+    }
+    return PyLong_FromLong(count);
+}
+
 static PyObject *
 Emitter_event_names(EmitterObject *self, PyObject *noargs)
 {
@@ -419,6 +762,33 @@ Emitter_event_names(EmitterObject *self, PyObject *noargs)
     return out;
 }
 
+/* FSM all-state-event enforcement (mirrors the pure-Python FSM.emit
+   override in fsm.py): an event declared all-state that nobody handled
+   is a silently-dropped signal — crash instead. Returns -1 with an
+   exception set if the event was declared all-state, 0 otherwise. */
+static int
+emit_check_all_state(EmitterObject *self, PyObject *event)
+{
+    if (self->inst_dict == NULL)
+        return 0;
+    PyObject *ase = PyDict_GetItemWithError(self->inst_dict,
+                                            str_all_state_events);
+    if (ase == NULL)
+        return PyErr_Occurred() ? -1 : 0;
+    int c = PySequence_Contains(ase, event);
+    if (c <= 0)
+        return c;
+    PyObject *st = PyDict_GetItemWithError(self->inst_dict,
+                                           str_fsm_state);
+    if (st == NULL && PyErr_Occurred())
+        return -1;
+    PyErr_Format(PyExc_RuntimeError,
+                 "%R: event \"%S\" (declared all-state) emitted in "
+                 "state \"%S\" with no handler",
+                 (PyObject *)self, event, st ? st : Py_None);
+    return -1;
+}
+
 static PyObject *
 Emitter_emit(EmitterObject *self, PyObject *args)
 {
@@ -432,11 +802,16 @@ Emitter_emit(EmitterObject *self, PyObject *args)
     if (lst == NULL) {
         if (PyErr_Occurred())
             return NULL;
+        if (emit_check_all_state(self, event) < 0)
+            return NULL;
         Py_RETURN_FALSE;
     }
     Py_ssize_t n = PyList_GET_SIZE(lst);
-    if (n == 0)
+    if (n == 0) {
+        if (emit_check_all_state(self, event) < 0)
+            return NULL;
         Py_RETURN_FALSE;
+    }
 
     PyObject *call_args = PyTuple_GetSlice(args, 1, nargs);
     if (call_args == NULL)
@@ -491,6 +866,8 @@ static PyMethodDef Emitter_methods[] = {
      "Snapshot list of listeners for event."},
     {"listener_count", (PyCFunction)Emitter_listener_count, METH_VARARGS,
      "Number of listeners for event."},
+    {"count_external", (PyCFunction)Emitter_count_external, METH_VARARGS,
+     "Number of non-framework listeners for event."},
     {"event_names", (PyCFunction)Emitter_event_names, METH_NOARGS,
      "Events with at least one listener."},
     {"emit", (PyCFunction)Emitter_emit, METH_VARARGS,
@@ -540,10 +917,25 @@ PyInit__cueball_native(void)
         PyUnicode_InternFromString("__wrapped_listener__");
     if (str_wrapped_listener == NULL)
         return NULL;
+    if ((str_on = PyUnicode_InternFromString("on")) == NULL ||
+        (str_remove_listener =
+            PyUnicode_InternFromString("remove_listener")) == NULL ||
+        (str_goto_state_priv =
+            PyUnicode_InternFromString("_goto_state")) == NULL ||
+        (str_get_state =
+            PyUnicode_InternFromString("get_state")) == NULL ||
+        (str_cueball_internal =
+            PyUnicode_InternFromString("_cueball_internal")) == NULL ||
+        (str_all_state_events =
+            PyUnicode_InternFromString("_fsm_all_state_events")) == NULL ||
+        (str_fsm_state =
+            PyUnicode_InternFromString("_fsm_state")) == NULL)
+        return NULL;
 
     if (PyType_Ready(&Emitter_Type) < 0 ||
         PyType_Ready(&Once_Type) < 0 ||
-        PyType_Ready(&Gate_Type) < 0)
+        PyType_Ready(&Gate_Type) < 0 ||
+        PyType_Ready(&SHandle_Type) < 0)
         return NULL;
 
     PyObject *m = PyModule_Create(&native_module);
@@ -560,6 +952,13 @@ PyInit__cueball_native(void)
     Py_INCREF(&Gate_Type);
     if (PyModule_AddObject(m, "Gate", (PyObject *)&Gate_Type) < 0) {
         Py_DECREF(&Gate_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&SHandle_Type);
+    if (PyModule_AddObject(m, "StateHandleBase",
+                           (PyObject *)&SHandle_Type) < 0) {
+        Py_DECREF(&SHandle_Type);
         Py_DECREF(m);
         return NULL;
     }

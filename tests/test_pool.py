@@ -128,6 +128,12 @@ def test_empty_pool():
         fut, _ = claim(pool, {'errorOnEmpty': True})
         with pytest.raises(mod_errors.NoBackendsError):
             await fut
+        # The failed handle must not have been queued as a waiter
+        # (counters are monitoring-visible; a phantom queued claim
+        # would also arm the codel pacer spuriously).
+        stats = pool.get_stats()
+        assert stats['waiterCount'] == 0
+        assert stats['counters'].get('queued-claim', 0) == 0
 
         fut2, _ = claim(pool, {'timeout': 100})
         with pytest.raises(mod_errors.ClaimTimeoutError):
