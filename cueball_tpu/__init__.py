@@ -61,12 +61,20 @@ from .agent import HttpAgent, HttpsAgent
 
 __version__ = '1.0.0'
 
+# camelCase aliases matching the reference's exact export names
+# (reference lib/index.js:17-38), for drop-in familiarity.
+resolverForIpOrDomain = resolver_for_ip_or_domain
+configForIpOrDomain = config_for_ip_or_domain
+poolMonitor = pool_monitor
+enableStackTraces = enable_stack_traces
+
 __all__ = [
     'ConnectionPool', 'ConnectionSet',
     'Resolver', 'DNSResolver', 'StaticIpResolver', 'ResolverFSM',
     'resolver_for_ip_or_domain', 'config_for_ip_or_domain',
+    'resolverForIpOrDomain', 'configForIpOrDomain',
     'HttpAgent', 'HttpsAgent',
-    'pool_monitor',
+    'pool_monitor', 'poolMonitor', 'enableStackTraces',
     'EventEmitter', 'FSM', 'Queue', 'ControlledDelay',
     'enable_stack_traces', 'stack_traces_enabled', 'current_millis',
     'plan_rebalance',

@@ -346,7 +346,15 @@ SHandle_add_disposable(SHandleObject *self, PyObject *d)
 static PyObject *
 SHandle_dispose_all(SHandleObject *self, PyObject *noargs)
 {
+    /* Steal the list before invoking anything: a disposable that
+       re-enters _dispose_all (or registers more) must not mutate the
+       sequence we are iterating (the calls below run arbitrary
+       Python). The re-entrant call sees a fresh empty list. */
     PyObject *lst = self->sh_disposables;
+    PyObject *fresh = PyList_New(0);
+    if (fresh == NULL)
+        return NULL;
+    self->sh_disposables = fresh;
     Py_ssize_t n = PyList_GET_SIZE(lst);
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *d = PyList_GET_ITEM(lst, i);
@@ -354,18 +362,21 @@ SHandle_dispose_all(SHandleObject *self, PyObject *noargs)
             PyObject *r = PyObject_CallMethodObjArgs(
                 PyTuple_GET_ITEM(d, 0), str_remove_listener,
                 PyTuple_GET_ITEM(d, 1), PyTuple_GET_ITEM(d, 2), NULL);
-            if (r == NULL)
+            if (r == NULL) {
+                Py_DECREF(lst);
                 return NULL;
+            }
             Py_DECREF(r);
         } else {
             PyObject *r = PyObject_CallNoArgs(d);
-            if (r == NULL)
+            if (r == NULL) {
+                Py_DECREF(lst);
                 return NULL;
+            }
             Py_DECREF(r);
         }
     }
-    if (PyList_SetSlice(lst, 0, PyList_GET_SIZE(lst), NULL) < 0)
-        return NULL;
+    Py_DECREF(lst);
     Py_RETURN_NONE;
 }
 
@@ -701,6 +712,11 @@ Emitter_count_external(EmitterObject *self, PyObject *args)
             return NULL;
         return PyLong_FromLong(0);
     }
+    /* Snapshot: the getattr/IsTrue calls below can run arbitrary
+       Python that mutates (or frees) the live listener list. */
+    lst = PyList_GetSlice(lst, 0, PyList_GET_SIZE(lst));
+    if (lst == NULL)
+        return NULL;
     Py_ssize_t n = PyList_GET_SIZE(lst);
     long count = 0;
     for (Py_ssize_t i = 0; i < n; i++) {
@@ -711,8 +727,10 @@ Emitter_count_external(EmitterObject *self, PyObject *args)
         if (v != NULL) {
             int internal = PyObject_IsTrue(v);
             Py_DECREF(v);
-            if (internal < 0)
+            if (internal < 0) {
+                Py_DECREF(lst);
                 return NULL;
+            }
             if (internal)
                 continue;
         }
@@ -727,6 +745,7 @@ Emitter_count_external(EmitterObject *self, PyObject *args)
                 Py_DECREF(wv);
                 if (skip < 0) {
                     Py_DECREF(w);
+                    Py_DECREF(lst);
                     return NULL;
                 }
             }
@@ -740,6 +759,7 @@ Emitter_count_external(EmitterObject *self, PyObject *args)
         }
         count++;
     }
+    Py_DECREF(lst);
     return PyLong_FromLong(count);
 }
 

@@ -220,3 +220,63 @@ def test_fsm_engine_uses_gate_semantics():
     m._goto_state('b')
     m.trigger.emit('go')
     assert fired == ['b']
+
+
+def test_dispose_all_reentrancy_is_safe():
+    """A disposable that re-enters _dispose_all must not corrupt the
+    iteration (C regression: stale length over a freed list)."""
+    class FSMish:
+        pass
+    f = FSMish()
+    h = native.StateHandleBase(f, 'x')
+    f._fsm_state_handle = h
+    calls = []
+
+    def reenter():
+        calls.append('reenter')
+        h._dispose_all()
+    h._add_disposable(reenter)
+    h._add_disposable(lambda: calls.append('b'))
+    h._add_disposable(lambda: calls.append('c'))
+    h._dispose_all()
+    assert calls == ['reenter', 'b', 'c']
+
+
+def test_count_external_survives_mutating_attribute():
+    """A listener whose _cueball_internal attribute mutates the emitter
+    mid-count must not invalidate the iteration (C regression:
+    use-after-free of the live listener list)."""
+    e = native.EventEmitter()
+
+    class Evil:
+        def __call__(self):
+            pass
+
+        @property
+        def _cueball_internal(self):
+            e.remove_all_listeners('x')
+            return False
+
+    e.on('x', Evil())
+    e.on('x', lambda: None)
+    assert e.count_external('x') == 2
+
+
+def test_count_external_propagates_attribute_errors():
+    """A raising __bool__ on _cueball_internal propagates instead of
+    being swallowed or tripping a SystemError (parity with the Python
+    count_listeners fallback)."""
+    class B:
+        def __bool__(self):
+            raise RuntimeError('boom')
+
+    class Raiser:
+        _cueball_internal = B()
+
+        def __call__(self):
+            pass
+
+    e = native.EventEmitter()
+    e.on('y', Raiser())
+    with pytest.raises(RuntimeError, match='boom'):
+        e.count_external('y')
