@@ -53,7 +53,7 @@ def count_listeners(emitter, event: str) -> int:
     (reference lib/connection-fsm.js:786-808 filters by function name; we
     mark internal handlers with a `_cueball_internal` attribute)."""
     try:
-        # Native emitters filter in C (same rules, no list copy).
+        # Native emitters filter in C over a snapshot of the list.
         return emitter.count_external(event)
     except AttributeError:
         pass

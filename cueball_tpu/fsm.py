@@ -142,12 +142,22 @@ class _PyStateHandle:
     # -- teardown --------------------------------------------------------
 
     def _dispose_all(self) -> None:
-        for d in self._disposables:
-            if type(d) is tuple:
-                d[0].remove_listener(d[1], d[2])
-            else:
-                d()
-        self._disposables.clear()
+        # Steal the list before invoking anything: a disposable that
+        # re-enters _dispose_all must see a fresh list, not re-run the
+        # sequence being iterated (mirrors the C StateHandleBase).
+        lst = self._disposables
+        self._disposables = []
+        for i, d in enumerate(lst):
+            try:
+                if type(d) is tuple:
+                    d[0].remove_listener(d[1], d[2])
+                else:
+                    d()
+            except BaseException:
+                # Keep the not-yet-run disposables reachable for a
+                # retry rather than leaking their registrations.
+                self._disposables.extend(lst[i:])
+                raise
 
 
 class _TimerRegistrationsMixin:

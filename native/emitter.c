@@ -358,23 +358,34 @@ SHandle_dispose_all(SHandleObject *self, PyObject *noargs)
     Py_ssize_t n = PyList_GET_SIZE(lst);
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *d = PyList_GET_ITEM(lst, i);
+        PyObject *r;
         if (PyTuple_CheckExact(d) && PyTuple_GET_SIZE(d) == 3) {
-            PyObject *r = PyObject_CallMethodObjArgs(
+            r = PyObject_CallMethodObjArgs(
                 PyTuple_GET_ITEM(d, 0), str_remove_listener,
                 PyTuple_GET_ITEM(d, 1), PyTuple_GET_ITEM(d, 2), NULL);
-            if (r == NULL) {
-                Py_DECREF(lst);
-                return NULL;
-            }
-            Py_DECREF(r);
         } else {
-            PyObject *r = PyObject_CallNoArgs(d);
-            if (r == NULL) {
-                Py_DECREF(lst);
-                return NULL;
-            }
-            Py_DECREF(r);
+            r = PyObject_CallNoArgs(d);
         }
+        if (r == NULL) {
+            /* Keep the not-yet-run disposables reachable for a retry
+               rather than leaking their registrations (mirrors the
+               pure-Python fallback). */
+            PyObject *exc = PyErr_GetRaisedException();
+            PyObject *rest = PyList_GetSlice(lst, i, n);
+            if (rest != NULL) {
+                PyObject *cur = self->sh_disposables;
+                Py_ssize_t cn = PyList_GET_SIZE(cur);
+                if (PyList_SetSlice(cur, cn, cn, rest) < 0)
+                    PyErr_Clear();
+                Py_DECREF(rest);
+            } else {
+                PyErr_Clear();
+            }
+            PyErr_SetRaisedException(exc);
+            Py_DECREF(lst);
+            return NULL;
+        }
+        Py_DECREF(r);
     }
     Py_DECREF(lst);
     Py_RETURN_NONE;

@@ -1,0 +1,18 @@
+"""Smoke tests: the shipped examples must actually run."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_multiplexed_set_client_example():
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, 'examples', 'multiplexed_set_client.py')],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert '60 calls spread over backends' in r.stdout
+    assert '30/30 calls served by the surviving backends' in r.stdout
+    assert 'clean shutdown' in r.stdout

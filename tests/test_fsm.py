@@ -322,3 +322,52 @@ def test_queued_transition_validated_against_intermediate_state():
         with pytest.raises(RuntimeError, match='invalid transition'):
             M()
     run_async(t())
+
+
+def test_py_dispose_all_reentrancy_is_safe():
+    """Pure-Python fallback parity with the C core: a disposable that
+    re-enters _dispose_all must not recurse over the same list."""
+    from cueball_tpu.fsm import _PyStateHandle
+
+    class FSMish:
+        pass
+    f = FSMish()
+    h = _PyStateHandle(f, 'x')
+    f._fsm_state_handle = h
+    calls = []
+
+    def reenter():
+        calls.append('reenter')
+        h._dispose_all()
+    h._disposables.append(reenter)
+    h._disposables.append(lambda: calls.append('b'))
+    h._disposables.append(lambda: calls.append('c'))
+    h._dispose_all()
+    assert calls == ['reenter', 'b', 'c']
+
+
+def test_dispose_all_error_keeps_remaining_disposables():
+    """If a disposable raises, the not-yet-run ones must stay
+    registered so a retry can still detach them (both cores)."""
+    import pytest
+    from cueball_tpu.fsm import _PyStateHandle, StateHandle
+
+    for cls in {_PyStateHandle, StateHandle}:
+        class FSMish:
+            pass
+        f = FSMish()
+        h = cls(f, 'x')
+        f._fsm_state_handle = h
+        ran = []
+
+        def boom():
+            raise RuntimeError('boom')
+        h._add_disposable(boom)
+        h._add_disposable(lambda: ran.append('late'))
+        with pytest.raises(RuntimeError, match='boom'):
+            h._dispose_all()
+        assert ran == []
+        # Retry after removing the bad one: the survivor still runs.
+        with pytest.raises(RuntimeError, match='boom'):
+            h._dispose_all()
+        assert ran == []
