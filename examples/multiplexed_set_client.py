@@ -62,7 +62,7 @@ class MuxConnection(EventEmitter):
                     fut.set_result(msg['result'])
         except OSError:
             pass
-        for fut in self._pending.values():
+        for fut in list(self._pending.values()):
             if not fut.done():
                 fut.set_exception(ConnectionResetError(
                     'backend %s went away' % self.backend['address']))
@@ -71,9 +71,16 @@ class MuxConnection(EventEmitter):
 
     def call(self, method, params):
         """Issue one multiplexed request; returns a future."""
+        if self._task.done() or self._writer is None or \
+                self._writer.is_closing():
+            raise ConnectionResetError(
+                'backend %s went away' % self.backend['address'])
         rid = next(self._ids)
         fut = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        # A cancelled waiter (e.g. wait_for timeout) must not linger as
+        # in-flight — the drain contract spins on in_flight reaching 0.
+        fut.add_done_callback(lambda f: self._pending.pop(rid, None))
         self._writer.write(json.dumps(
             {'id': rid, 'method': method, 'params': params}
         ).encode() + b'\n')
@@ -135,10 +142,23 @@ class MuxClient:
         asyncio.ensure_future(drain())
 
     async def call(self, method, params, timeout=2.0):
-        while not self._conns:
-            await asyncio.sleep(0.01)
-        key, (conn, _h) = next(self._rr)
-        return await asyncio.wait_for(conn.call(method, params), timeout)
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            while not self._conns:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise TimeoutError('no backends available')
+                await asyncio.sleep(0.01)
+            key, (conn, _h) = next(self._rr)
+            try:
+                fut = conn.call(method, params)
+            except ConnectionResetError:
+                # Raced a dying connection before its 'removed' event
+                # was delivered; drop it locally and retry another.
+                self._conns.pop(key, None)
+                self._rr = itertools.cycle(list(self._conns.items()))
+                continue
+            remaining = deadline - asyncio.get_running_loop().time()
+            return await asyncio.wait_for(fut, max(remaining, 0.001))
 
     async def stop(self):
         self.cset.stop()

@@ -49,3 +49,37 @@ def test_dns_mode_bad_input_fails_cleanly():
     assert r.returncode != 0
     out = r.stdout + r.stderr
     assert 'Traceback' not in out
+
+
+def test_dns_mode_end_to_end_over_wire():
+    """cbresolve in DNS mode against a scripted local nameserver: the
+    full stack (CLI -> DNSResolver -> DnsClient -> UDP wire) resolves
+    the SRV-discovered backend."""
+    import asyncio
+    import os
+    sys.path.insert(0, os.path.join(REPO, 'tests'))
+    from test_dns_client import ScriptedNS
+
+    async def t():
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            ScriptedNS, local_addr=('127.0.0.1', 0))
+        port = transport.get_extra_info('sockname')[1]
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, '-m', 'cueball_tpu.cli',
+                '-r', '127.0.0.1@%d' % port,
+                '-s', '_svc._tcp', '-t', '5000', 'svc.test',
+                cwd=REPO,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE)
+            out, err = await asyncio.wait_for(proc.communicate(), 30)
+        finally:
+            transport.close()
+        assert proc.returncode == 0, err.decode()
+        # ScriptedNS serves svc.test SRV -> backend.svc.test:8080 -> A
+        # 10.1.2.3 (see tests/test_dns_client.py).
+        assert '10.1.2.3' in out.decode()
+        assert '8080' in out.decode()
+
+    asyncio.run(t())

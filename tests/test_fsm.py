@@ -347,8 +347,8 @@ def test_py_dispose_all_reentrancy_is_safe():
 
 
 def test_dispose_all_error_keeps_remaining_disposables():
-    """If a disposable raises, the not-yet-run ones must stay
-    registered so a retry can still detach them (both cores)."""
+    """If a disposable raises, it and the not-yet-run ones must stay
+    registered so a retry can still run them (both cores)."""
     import pytest
     from cueball_tpu.fsm import _PyStateHandle, StateHandle
 
@@ -359,15 +359,21 @@ def test_dispose_all_error_keeps_remaining_disposables():
         h = cls(f, 'x')
         f._fsm_state_handle = h
         ran = []
+        attempts = []
 
-        def boom():
-            raise RuntimeError('boom')
-        h._add_disposable(boom)
+        def flaky():
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise RuntimeError('boom')
+        h._add_disposable(flaky)
         h._add_disposable(lambda: ran.append('late'))
         with pytest.raises(RuntimeError, match='boom'):
             h._dispose_all()
         assert ran == []
-        # Retry after removing the bad one: the survivor still runs.
-        with pytest.raises(RuntimeError, match='boom'):
-            h._dispose_all()
-        assert ran == []
+        # Retry: both retained disposables run this time.
+        h._dispose_all()
+        assert ran == ['late']
+        assert len(attempts) == 2
+        # And the list is now empty: a third call is a no-op.
+        h._dispose_all()
+        assert ran == ['late'] and len(attempts) == 2
