@@ -326,7 +326,7 @@ class FSM(EventEmitter):
             # replay on a later, unrelated goto_state.
             self._fsm_pending.clear()
 
-    def _run_transition(self, state: str) -> None:
+    def _py_run_transition(self, state: str) -> None:
         old = self._fsm_state
         if self._fsm_state_handle is not None:
             self._fsm_state_handle._dispose_all()
@@ -372,5 +372,22 @@ class FSM(EventEmitter):
             # No loop (e.g. pure-unit tests of sync FSMs): emit inline.
             self.emit('stateChanged', state)
 
+    if _native is None:
+        _run_transition = _py_run_transition
+    else:
+        def _run_transition(self, state: str) -> None:
+            # C port of _py_run_transition (native/emitter.c
+            # fsm_run_transition); the Python body above remains the
+            # reference semantics and the CUEBALL_NO_NATIVE fallback.
+            _native.fsm_run_transition(self, state)
+
     def __repr__(self) -> str:
         return '<%s state=%s>' % (type(self).__name__, self._fsm_state)
+
+
+if _native is not None:
+    # Inject the Python-side pieces the C transition engine needs: the
+    # concrete StateHandle class, the (shared, mutable) tracer list,
+    # and asyncio's running-loop accessor.
+    _native.fsm_configure(StateHandle, _TRANSITION_TRACERS,
+                          asyncio.get_running_loop)

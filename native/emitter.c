@@ -929,13 +929,304 @@ static PyTypeObject Emitter_Type = {
 };
 
 /* ------------------------------------------------------------------ */
+/* FSM transition engine                                               */
+/*                                                                     */
+/* C port of fsm.py FSM._run_transition — the single hottest Python    */
+/* function on the claim path (6 transitions per claim/release         */
+/* cycle). Python-side dependencies (the StateHandle class, the        */
+/* transition-tracer list, asyncio.get_running_loop) are injected      */
+/* once via fsm_configure() at cueball_tpu.fsm import time. The        */
+/* pure-Python _run_transition remains the reference semantics and     */
+/* the fallback.                                                       */
+
+static PyObject *fsm_handle_class;     /* StateHandle */
+static PyObject *fsm_tracers;          /* list, shared with fsm.py */
+static PyObject *fsm_get_running_loop; /* asyncio.get_running_loop */
+
+static PyObject *str_fsm_history;      /* "_fsm_history" */
+static PyObject *str_dispose_all_name; /* "_dispose_all" */
+static PyObject *str_entry_cache;      /* "_fsm_entry_cache" */
+static PyObject *str_history_length;   /* "HISTORY_LENGTH" */
+static PyObject *str_call_soon;        /* "call_soon" */
+static PyObject *str_emit;             /* "emit" */
+static PyObject *str_state_changed;    /* "stateChanged" */
+static PyObject *str_state_prefix;     /* "state_" */
+static PyObject *str_dot;              /* "." */
+static PyObject *str_underscore;       /* "_" */
+
+static PyObject *
+fsm_configure(PyObject *mod, PyObject *args)
+{
+    PyObject *handle_cls, *tracers, *get_loop;
+    if (!PyArg_ParseTuple(args, "OOO", &handle_cls, &tracers, &get_loop))
+        return NULL;
+    Py_INCREF(handle_cls);
+    Py_XSETREF(fsm_handle_class, handle_cls);
+    Py_INCREF(tracers);
+    Py_XSETREF(fsm_tracers, tracers);
+    Py_INCREF(get_loop);
+    Py_XSETREF(fsm_get_running_loop, get_loop);
+    Py_RETURN_NONE;
+}
+
+/* Resolve the entry function for `state` on type(fsm), with the same
+   per-class cache the Python engine uses (stored under
+   _fsm_entry_cache in the class __dict__, never inherited). Returns a
+   borrowed-from-cache strong reference. */
+static PyObject *
+fsm_lookup_entry(PyObject *fsm, PyObject *state)
+{
+    PyTypeObject *cls = Py_TYPE(fsm);
+    PyObject *cache = PyDict_GetItemWithError(cls->tp_dict,
+                                              str_entry_cache);
+    if (cache == NULL) {
+        if (PyErr_Occurred())
+            return NULL;
+        cache = PyDict_New();
+        if (cache == NULL)
+            return NULL;
+        if (PyDict_SetItem(cls->tp_dict, str_entry_cache, cache) < 0) {
+            Py_DECREF(cache);
+            return NULL;
+        }
+        PyType_Modified(cls);
+        Py_DECREF(cache);
+        cache = PyDict_GetItemWithError(cls->tp_dict, str_entry_cache);
+        if (cache == NULL)
+            return NULL;
+    }
+    PyObject *entry = PyDict_GetItemWithError(cache, state);
+    if (entry != NULL) {
+        Py_INCREF(entry);
+        return entry;
+    }
+    if (PyErr_Occurred())
+        return NULL;
+    /* Miss: build "state_" + state.replace(".", "_"), look it up on
+       the class (unbound), and memoize. The attribute lookup can run
+       arbitrary Python (descriptors, metaclass hooks) that might
+       replace the cache attribute — hold our own reference. */
+    Py_INCREF(cache);
+    PyObject *munged = PyUnicode_Replace(state, str_dot,
+                                         str_underscore, -1);
+    if (munged == NULL) {
+        Py_DECREF(cache);
+        return NULL;
+    }
+    PyObject *name = PyUnicode_Concat(str_state_prefix, munged);
+    Py_DECREF(munged);
+    if (name == NULL) {
+        Py_DECREF(cache);
+        return NULL;
+    }
+    entry = PyObject_GetAttr((PyObject *)cls, name);
+    Py_DECREF(name);
+    if (entry == NULL) {
+        Py_DECREF(cache);
+        /* Only a missing attribute means "unknown state"; any other
+           failure (descriptor raising, MemoryError, ...) propagates,
+           matching the Python fallback's getattr(..., None). */
+        if (!PyErr_ExceptionMatches(PyExc_AttributeError))
+            return NULL;
+        PyErr_Clear();
+        PyErr_Format(PyExc_RuntimeError, "%R: unknown state \"%S\"",
+                     fsm, state);
+        return NULL;
+    }
+    if (PyDict_SetItem(cache, state, entry) < 0) {
+        Py_DECREF(cache);
+        Py_DECREF(entry);
+        return NULL;
+    }
+    Py_DECREF(cache);
+    return entry;
+}
+
+static PyObject *
+fsm_run_transition(PyObject *mod, PyObject *args)
+{
+    PyObject *fsm, *state;
+    if (!PyArg_ParseTuple(args, "OO", &fsm, &state))
+        return NULL;
+    if (fsm_handle_class == NULL) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "fsm_configure() has not been called");
+        return NULL;
+    }
+
+    PyObject *old = PyObject_GetAttr(fsm, str_fsm_state);
+    if (old == NULL)
+        return NULL;
+
+    PyObject *cur_handle = PyObject_GetAttr(fsm, str_fsm_state_handle);
+    if (cur_handle == NULL) {
+        Py_DECREF(old);
+        return NULL;
+    }
+    if (cur_handle != Py_None) {
+        PyObject *r;
+        if (Py_TYPE(cur_handle) == &SHandle_Type ||
+            PyType_IsSubtype(Py_TYPE(cur_handle), &SHandle_Type)) {
+            r = SHandle_dispose_all((SHandleObject *)cur_handle, NULL);
+        } else {
+            r = PyObject_CallMethodNoArgs(cur_handle,
+                                          str_dispose_all_name);
+        }
+        if (r == NULL) {
+            Py_DECREF(cur_handle);
+            Py_DECREF(old);
+            return NULL;
+        }
+        Py_DECREF(r);
+        if (PyObject_SetAttr(fsm, str_fsm_state_handle, Py_None) < 0) {
+            Py_DECREF(cur_handle);
+            Py_DECREF(old);
+            return NULL;
+        }
+    }
+    Py_DECREF(cur_handle);
+
+    PyObject *entry = fsm_lookup_entry(fsm, state);
+    if (entry == NULL) {
+        Py_DECREF(old);
+        return NULL;
+    }
+
+    if (PyObject_SetAttr(fsm, str_fsm_state, state) < 0)
+        goto fail;
+
+    /* History ring buffer. */
+    {
+        PyObject *hist = PyObject_GetAttr(fsm, str_fsm_history);
+        if (hist == NULL || !PyList_Check(hist)) {
+            Py_XDECREF(hist);
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_TypeError,
+                                "_fsm_history must be a list");
+            goto fail;
+        }
+        if (PyList_Append(hist, state) < 0) {
+            Py_DECREF(hist);
+            goto fail;
+        }
+        PyObject *hl = PyObject_GetAttr(fsm, str_history_length);
+        if (hl == NULL) {
+            Py_DECREF(hist);
+            goto fail;
+        }
+        Py_ssize_t maxlen = PyLong_AsSsize_t(hl);
+        Py_DECREF(hl);
+        if (maxlen == -1 && PyErr_Occurred()) {
+            Py_DECREF(hist);
+            goto fail;
+        }
+        Py_ssize_t n = PyList_GET_SIZE(hist);
+        if (n > maxlen) {
+            if (PyList_SetSlice(hist, 0, n - maxlen, NULL) < 0) {
+                Py_DECREF(hist);
+                goto fail;
+            }
+        }
+        Py_DECREF(hist);
+    }
+
+    /* New handle becomes current before the entry function runs. */
+    {
+        PyObject *handle = PyObject_CallFunctionObjArgs(
+            fsm_handle_class, fsm, state, NULL);
+        if (handle == NULL)
+            goto fail;
+        if (PyObject_SetAttr(fsm, str_fsm_state_handle, handle) < 0) {
+            Py_DECREF(handle);
+            goto fail;
+        }
+
+        if (fsm_tracers != NULL && PyList_Check(fsm_tracers) &&
+            PyList_GET_SIZE(fsm_tracers) > 0) {
+            PyObject *snap = PyList_GetSlice(
+                fsm_tracers, 0, PyList_GET_SIZE(fsm_tracers));
+            if (snap == NULL) {
+                Py_DECREF(handle);
+                goto fail;
+            }
+            for (Py_ssize_t i = 0; i < PyList_GET_SIZE(snap); i++) {
+                PyObject *r = PyObject_CallFunctionObjArgs(
+                    PyList_GET_ITEM(snap, i), fsm, old, state, NULL);
+                if (r == NULL) {
+                    Py_DECREF(snap);
+                    Py_DECREF(handle);
+                    goto fail;
+                }
+                Py_DECREF(r);
+            }
+            Py_DECREF(snap);
+        }
+
+        PyObject *r = PyObject_CallFunctionObjArgs(entry, fsm, handle,
+                                                   NULL);
+        Py_DECREF(handle);
+        if (r == NULL)
+            goto fail;
+        Py_DECREF(r);
+    }
+
+    /* Deferred stateChanged emission (setImmediate analogue); inline
+       when no loop is running (pure-unit sync FSM tests). */
+    {
+        PyObject *loop = PyObject_CallNoArgs(fsm_get_running_loop);
+        if (loop == NULL) {
+            if (!PyErr_ExceptionMatches(PyExc_RuntimeError))
+                goto fail;
+            PyErr_Clear();
+            PyObject *r = PyObject_CallMethodObjArgs(
+                fsm, str_emit, str_state_changed, state, NULL);
+            if (r == NULL)
+                goto fail;
+            Py_DECREF(r);
+        } else {
+            PyObject *emit = PyObject_GetAttr(fsm, str_emit);
+            if (emit == NULL) {
+                Py_DECREF(loop);
+                goto fail;
+            }
+            PyObject *r = PyObject_CallMethodObjArgs(
+                loop, str_call_soon, emit, str_state_changed, state,
+                NULL);
+            Py_DECREF(emit);
+            Py_DECREF(loop);
+            if (r == NULL)
+                goto fail;
+            Py_DECREF(r);
+        }
+    }
+
+    Py_DECREF(entry);
+    Py_DECREF(old);
+    Py_RETURN_NONE;
+
+fail:
+    Py_DECREF(entry);
+    Py_DECREF(old);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
 /* module                                                              */
+
+static PyMethodDef native_methods[] = {
+    {"fsm_configure", (PyCFunction)fsm_configure, METH_VARARGS,
+     "Inject (StateHandle class, tracer list, get_running_loop)."},
+    {"fsm_run_transition", (PyCFunction)fsm_run_transition, METH_VARARGS,
+     "Run one FSM state transition (C port of FSM._run_transition)."},
+    {NULL}
+};
 
 static struct PyModuleDef native_module = {
     PyModuleDef_HEAD_INIT,
     .m_name = "cueball_tpu._cueball_native",
     .m_doc = "Native event-dispatch core (see module header comment).",
     .m_size = -1,
+    .m_methods = native_methods,
 };
 
 PyMODINIT_FUNC
@@ -960,7 +1251,24 @@ PyInit__cueball_native(void)
         (str_all_state_events =
             PyUnicode_InternFromString("_fsm_all_state_events")) == NULL ||
         (str_fsm_state =
-            PyUnicode_InternFromString("_fsm_state")) == NULL)
+            PyUnicode_InternFromString("_fsm_state")) == NULL ||
+        (str_fsm_history =
+            PyUnicode_InternFromString("_fsm_history")) == NULL ||
+        (str_dispose_all_name =
+            PyUnicode_InternFromString("_dispose_all")) == NULL ||
+        (str_entry_cache =
+            PyUnicode_InternFromString("_fsm_entry_cache")) == NULL ||
+        (str_history_length =
+            PyUnicode_InternFromString("HISTORY_LENGTH")) == NULL ||
+        (str_call_soon =
+            PyUnicode_InternFromString("call_soon")) == NULL ||
+        (str_emit = PyUnicode_InternFromString("emit")) == NULL ||
+        (str_state_changed =
+            PyUnicode_InternFromString("stateChanged")) == NULL ||
+        (str_state_prefix =
+            PyUnicode_InternFromString("state_")) == NULL ||
+        (str_dot = PyUnicode_InternFromString(".")) == NULL ||
+        (str_underscore = PyUnicode_InternFromString("_")) == NULL)
         return NULL;
 
     if (PyType_Ready(&Emitter_Type) < 0 ||

@@ -65,6 +65,7 @@ def test_dns_mode_end_to_end_over_wire():
         transport, _ = await loop.create_datagram_endpoint(
             ScriptedNS, local_addr=('127.0.0.1', 0))
         port = transport.get_extra_info('sockname')[1]
+        proc = None
         try:
             proc = await asyncio.create_subprocess_exec(
                 sys.executable, '-m', 'cueball_tpu.cli',
@@ -74,6 +75,11 @@ def test_dns_mode_end_to_end_over_wire():
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.PIPE)
             out, err = await asyncio.wait_for(proc.communicate(), 30)
+        except BaseException:
+            if proc is not None and proc.returncode is None:
+                proc.kill()
+                await proc.wait()
+            raise
         finally:
             transport.close()
         assert proc.returncode == 0, err.decode()
