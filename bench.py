@@ -150,10 +150,10 @@ async def bench_codel_tracking():
 async def bench_claim_throughput():
     """Driver config #1: raw claim/release cycles per second.
 
-    Best of 3 short rounds — single rounds swing with machine load."""
+    Best of 5 short rounds — single rounds swing with machine load."""
     build_pool = make_fixture()
     best = 0.0
-    for _ in range(3):
+    for _ in range(5):
         pool = build_pool()
         await settle(pool)
         n = 0

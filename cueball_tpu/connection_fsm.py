@@ -739,6 +739,17 @@ class ConnectionSlotFSM(FSM):
         def on_unwanted():
             if smgr.is_in_state('connected'):
                 S.gotoState('stopping')
+            elif smgr.is_in_state('error') or smgr.is_in_state('closed'):
+                # The disconnect landed in this same loop turn and its
+                # stateChanged is still queued. The reference's guard
+                # only handles a connected smgr
+                # (lib/connection-fsm.js:1065-1069); with the entry
+                # short-circuit below that strands an unwanted slot in
+                # 'idle' with no registrations at all — nothing would
+                # ever move it again and pool.stop() hangs in
+                # 'stopping.backends' (found by tests/test_soak.py).
+                # The slot is unwanted and the socket is gone: finish.
+                S.gotoState('stopped')
 
         if not self.csf_wanted:
             on_unwanted()
