@@ -1,0 +1,66 @@
+"""Shared scaffolding for the randomized FSM soaks
+(test_soak.py / test_soak_cset.py): backend topology churn and
+connection-fate injection over the DummyConnection protocol."""
+
+
+class TopoChaos:
+    """Drives a DummyInner resolver's backend set and picks connection
+    fates. One instance per scenario; all randomness via the seeded rng
+    so failures reproduce."""
+
+    def __init__(self, rng, ctx, inner, max_backends=4):
+        self.rng = rng
+        self.ctx = ctx
+        self.inner = inner
+        self.max_backends = max_backends
+        self.live = []
+        self._counter = 0
+
+    # -- topology --------------------------------------------------------
+
+    def add_backend(self):
+        if len(self.live) >= self.max_backends:
+            return
+        self._counter += 1
+        k = 'b%d' % self._counter
+        self.live.append(k)
+        self.inner.emit('added', k, {})
+
+    def remove_backend(self):
+        if len(self.live) > 1:
+            self.inner.emit(
+                'removed', self.live.pop(
+                    self.rng.randrange(len(self.live))))
+
+    # -- connection fates ------------------------------------------------
+
+    def connectable(self):
+        return [c for c in self.ctx.connections
+                if not c.connected and not c.dead]
+
+    def connected(self):
+        return [c for c in self.ctx.connections if c.connected]
+
+    def connect_random(self):
+        conns = self.connectable()
+        if conns:
+            self.rng.choice(conns).connect()
+
+    def error_random(self, tag):
+        conns = self.connected()
+        if conns:
+            self.rng.choice(conns).emit(
+                'error', RuntimeError('soak-%s' % tag))
+
+    def close_random(self):
+        conns = self.connected()
+        if conns:
+            c = self.rng.choice(conns)
+            # The DummyConnection close protocol: mark disconnected
+            # before emitting so a subsequent reconnect is legal.
+            c.connected = False
+            c.emit('close')
+
+    def connect_stragglers(self):
+        for c in self.connectable():
+            c.connect()

@@ -6,12 +6,12 @@ CHANGES.adoc #92 #108 #111 #144; SURVEY.md §7.4). The targeted
 regression tests pin those four; this soak drives *all* the machines
 at once with seeded random chaos — topology churn, connection
 connects/errors/closes, claim/release/close/cancel traffic — and
-asserts the system-level invariants: every claim callback resolves
+asserts the system-level invariants: pool accounting stays
+self-consistent at every checkpoint, every claim callback resolves
 with a documented error type, and the pool always quiesces to
 'stopped'. Seeds are fixed so failures reproduce."""
 
 import asyncio
-import itertools
 import random
 
 import pytest
@@ -19,6 +19,7 @@ import pytest
 from cueball_tpu import errors as mod_errors
 
 from conftest import run_async, settle, wait_for_state
+from soak_common import TopoChaos
 from test_pool import Ctx, make_pool
 
 ALLOWED_ERRORS = (
@@ -29,32 +30,26 @@ ALLOWED_ERRORS = (
 )
 
 
+def check_stats_invariants(pool):
+    """Cross-check get_stats() against the pool's internal accounting
+    (the reference pins these via getStats, #132)."""
+    stats = pool.get_stats()
+    total = sum(len(v) for v in pool.p_connections.values())
+    assert stats['totalConnections'] == total
+    assert stats['idleConnections'] + stats['pendingConnections'] \
+        <= stats['totalConnections']
+    assert stats['waiterCount'] == len(pool.p_waiters)
+
+
 async def _soak(seed, actions=350):
     rng = random.Random(seed)
     ctx = Ctx()
     pool, inner = make_pool(ctx, spares=2, maximum=6, retries=2,
                             timeout=200, delay=20)
-    counter = itertools.count()
-    live = []            # backend keys currently advertised
+    chaos = TopoChaos(rng, ctx, inner)
     held = []            # claimed handles we must eventually return
     waiters = []         # claim handles still unresolved
     bad = []             # unexpected claim errors
-
-    def add_backend():
-        k = 'b%d' % next(counter)
-        live.append(k)
-        inner.emit('added', k, {})
-
-    def remove_backend():
-        if len(live) > 1:
-            inner.emit('removed', live.pop(rng.randrange(len(live))))
-
-    def connectable():
-        return [c for c in ctx.connections
-                if not c.connected and not c.dead]
-
-    def connected():
-        return [c for c in ctx.connections if c.connected]
 
     def make_claim():
         holder = {}
@@ -75,31 +70,21 @@ async def _soak(seed, actions=350):
         holder['h'] = pool.claim_cb({'timeout': 400}, cb)
         waiters.append(holder['h'])
 
-    add_backend()
+    chaos.add_backend()
     await settle()
 
     for step in range(actions):
         roll = rng.random()
         if roll < 0.30:
-            conns = connectable()
-            if conns:
-                rng.choice(conns).connect()
+            chaos.connect_random()
         elif roll < 0.40:
-            conns = connected()
-            if conns:
-                rng.choice(conns).emit(
-                    'error', RuntimeError('soak-%d' % step))
+            chaos.error_random(step)
         elif roll < 0.45:
-            conns = connected()
-            if conns:
-                c = rng.choice(conns)
-                c.connected = False
-                c.emit('close')
+            chaos.close_random()
         elif roll < 0.55:
-            if len(live) < 4:
-                add_backend()
+            chaos.add_backend()
         elif roll < 0.62:
-            remove_backend()
+            chaos.remove_backend()
         elif roll < 0.85:
             make_claim()
         elif roll < 0.93 and held:
@@ -116,9 +101,7 @@ async def _soak(seed, actions=350):
             # tracking it here.
             w.cancel()
         if step % 10 == 0:
-            stats = pool.get_stats()
-            assert stats['waiterCount'] >= 0
-            assert stats['totalConnections'] >= 0
+            check_stats_invariants(pool)
             await settle()
 
     # Quiesce: keep connecting stragglers and returning leases until
@@ -127,8 +110,7 @@ async def _soak(seed, actions=350):
     deadline = asyncio.get_running_loop().time() + 5.0
     while (waiters or held) and \
             asyncio.get_running_loop().time() < deadline:
-        for c in connectable():
-            c.connect()
+        chaos.connect_stragglers()
         while held:
             h = held.pop()
             h._soak_conn.remove_listener('error', h._soak_listener)

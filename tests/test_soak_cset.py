@@ -1,0 +1,103 @@
+"""Seeded randomized soak of the ConnectionSet FSM stack.
+
+Companion to tests/test_soak.py for the Set side: LogicalConnection's
+init→advertised→draining→stopped lifecycle plus the consumer drain
+contract are driven with random topology churn, connection fates,
+target resizes, and lazily-returned drain handles. Invariants: every
+'added' is eventually paired with a 'removed' for the same logical
+connection key, handles released late still drain cleanly, and the
+set always quiesces to 'stopped'. Seeds fixed for reproducibility."""
+
+import asyncio
+import random
+
+import pytest
+
+from conftest import run_async, settle, wait_for_state
+from soak_common import TopoChaos
+from test_cset import make_cset
+from test_pool import Ctx
+
+
+async def _soak(seed, actions=300):
+    rng = random.Random(seed)
+    ctx = Ctx()
+    cset, inner, resolver = make_cset(ctx, target=2, maximum=5,
+                                      retries=2, timeout=200, delay=20)
+    chaos = TopoChaos(rng, ctx, inner)
+    advertised = {}          # logical key -> (conn, handle)
+    added_keys = []
+    removed_keys = []
+    pending_release = [0]
+
+    def on_added(key, conn, hdl):
+        added_keys.append(key)
+        advertised[key] = (conn, hdl)
+        conn.on('error', lambda e=None: None)
+
+    def on_removed(key, conn, hdl):
+        removed_keys.append(key)
+        advertised.pop(key, None)
+        # Consumer drain: sometimes instant, sometimes lazy — the set
+        # must wait for the handle either way.
+        if rng.random() < 0.5:
+            hdl.release()
+        else:
+            pending_release[0] += 1
+
+            def later():
+                pending_release[0] -= 1
+                hdl.release()
+            asyncio.get_running_loop().call_later(
+                rng.uniform(0.01, 0.08), later)
+
+    cset.on('added', on_added)
+    cset.on('removed', on_removed)
+
+    chaos.add_backend()
+    await settle()
+
+    for step in range(actions):
+        roll = rng.random()
+        if roll < 0.35:
+            chaos.connect_random()
+        elif roll < 0.45:
+            chaos.error_random(step)
+        elif roll < 0.52:
+            chaos.close_random()
+        elif roll < 0.65:
+            chaos.add_backend()
+        elif roll < 0.75:
+            chaos.remove_backend()
+        else:
+            cset.set_target(rng.randint(1, 4))
+        if step % 10 == 0:
+            # Ordering-insensitive invariant: until 'removed' is
+            # delivered and the consumer releases, every advertised
+            # handle is still a claimed lease the set must honor.
+            for key, (_c, h) in advertised.items():
+                assert h.is_in_state('claimed'), (
+                    '%s handle in %s' % (key, h.get_state()))
+            await settle()
+
+    # Quiesce: connect stragglers, then stop. 'removed' fires for every
+    # advertised connection during stopping; lazy releases drain after.
+    chaos.connect_stragglers()
+    await settle()
+    cset.stop()
+    await wait_for_state(cset, 'stopped', timeout=10)
+    deadline = asyncio.get_running_loop().time() + 2.0
+    while pending_release[0] and \
+            asyncio.get_running_loop().time() < deadline:
+        await asyncio.sleep(0.02)
+
+    assert not advertised, ('connections still advertised after stop: '
+                            '%r' % list(advertised))
+    assert sorted(added_keys) == sorted(removed_keys), (
+        'added/removed pairing broken: %d added, %d removed' % (
+            len(added_keys), len(removed_keys)))
+
+
+@pytest.mark.parametrize('seed', [11, 47, 2003])
+def test_soak_cset_random_chaos(seed):
+    run_async(_soak(seed), timeout=60)
