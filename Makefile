@@ -7,7 +7,7 @@ PYTHON ?= python3
 native:
 	$(PYTHON) native/build.py
 
-test:
+test: native
 	$(PYTHON) -m pytest tests/ -x -q
 
 check:

@@ -697,12 +697,15 @@ Emitter_listener_count(EmitterObject *self, PyObject *args)
     return PyLong_FromSsize_t(PyList_GET_SIZE(lst));
 }
 
-/* attr or NULL (missing attr cleared), like getattr(o, name, None) */
+/* attr or NULL with AttributeError cleared, like getattr(o, name, None).
+   Any other exception (a raising property, MemoryError, ...) stays set,
+   matching Python getattr semantics — callers must treat NULL with
+   PyErr_Occurred() as a failure to propagate. */
 static PyObject *
 getattr_or_null(PyObject *o, PyObject *name)
 {
     PyObject *v = PyObject_GetAttr(o, name);
-    if (v == NULL)
+    if (v == NULL && PyErr_ExceptionMatches(PyExc_AttributeError))
         PyErr_Clear();
     return v;
 }
@@ -744,10 +747,17 @@ Emitter_count_external(EmitterObject *self, PyObject *args)
             }
             if (internal)
                 continue;
+        } else if (PyErr_Occurred()) {
+            Py_DECREF(lst);
+            return NULL;
         }
         if (Py_TYPE(h) == &Gate_Type)
             continue;
         PyObject *w = getattr_or_null(h, str_wrapped_listener);
+        if (w == NULL && PyErr_Occurred()) {
+            Py_DECREF(lst);
+            return NULL;
+        }
         if (w != NULL && w != Py_None) {
             PyObject *wv = getattr_or_null(w, str_cueball_internal);
             int skip = 0;
@@ -759,6 +769,10 @@ Emitter_count_external(EmitterObject *self, PyObject *args)
                     Py_DECREF(lst);
                     return NULL;
                 }
+            } else if (PyErr_Occurred()) {
+                Py_DECREF(w);
+                Py_DECREF(lst);
+                return NULL;
             }
             if (!skip && Py_TYPE(w) == &Gate_Type)
                 skip = 1;
@@ -985,15 +999,30 @@ fsm_lookup_entry(PyObject *fsm, PyObject *state)
         cache = PyDict_New();
         if (cache == NULL)
             return NULL;
-        if (PyDict_SetItem(cls->tp_dict, str_entry_cache, cache) < 0) {
+        /* Install via type.__setattr__ (not raw tp_dict mutation): it
+           handles cache invalidation itself and keeps us off the
+           direct-tp_dict-write path CPython 3.12+ discourages. The FSM
+           classes are always heap types, so setattr is permitted. */
+        if (PyObject_SetAttr((PyObject *)cls, str_entry_cache,
+                             cache) < 0) {
             Py_DECREF(cache);
             return NULL;
         }
-        PyType_Modified(cls);
         Py_DECREF(cache);
         cache = PyDict_GetItemWithError(cls->tp_dict, str_entry_cache);
-        if (cache == NULL)
+        if (cache == NULL || !PyDict_Check(cache)) {
+            /* A metaclass __setattr__ that diverts or transforms the
+               store can leave the class __dict__ without the key (or
+               with a non-dict) even though SetAttr succeeded; never
+               return NULL without an exception set, and never hand a
+               non-dict to PyDict_GetItemWithError. */
+            if (!PyErr_Occurred())
+                PyErr_Format(PyExc_RuntimeError,
+                             "%R: class __setattr__ did not store the "
+                             "_fsm_entry_cache dict in the class "
+                             "__dict__", (PyObject *)cls);
             return NULL;
+        }
     }
     PyObject *entry = PyDict_GetItemWithError(cache, state);
     if (entry != NULL) {

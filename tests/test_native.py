@@ -2,11 +2,23 @@
 the pure-Python reference semantics (cueball_tpu/events.py) exactly —
 both cores stay shippable, selected at import via CUEBALL_NO_NATIVE."""
 
+import os
+
 import pytest
 
 from cueball_tpu.events import PyEventEmitter
 
-native = pytest.importorskip('cueball_tpu._cueball_native')
+try:
+    import cueball_tpu._cueball_native as native
+except ImportError:
+    if os.environ.get('CUEBALL_NO_NATIVE') == '1':
+        # Explicitly running the pure-Python configuration: nothing to
+        # compare against, a skip is the honest outcome.
+        native = pytest.importorskip('cueball_tpu._cueball_native')
+    raise RuntimeError(
+        'cueball_tpu._cueball_native is not built; run `make native` '
+        '(or set CUEBALL_NO_NATIVE=1 to test the pure-Python core '
+        'only). Refusing to silently skip the native parity suite.')
 
 CORES = [PyEventEmitter, native.EventEmitter]
 
@@ -280,3 +292,50 @@ def test_count_external_propagates_attribute_errors():
     e.on('y', Raiser())
     with pytest.raises(RuntimeError, match='boom'):
         e.count_external('y')
+
+
+def test_count_external_propagates_raising_property():
+    """A _cueball_internal property that raises a non-AttributeError must
+    propagate, not be treated as attribute-absent (parity with Python
+    getattr(obj, name, default), which only swallows AttributeError)."""
+    class RaisingProp:
+        def __call__(self):
+            pass
+
+        @property
+        def _cueball_internal(self):
+            raise RuntimeError('prop boom')
+
+    e = native.EventEmitter()
+    e.on('z', RaisingProp())
+    with pytest.raises(RuntimeError, match='prop boom'):
+        e.count_external('z')
+
+    class RaisingWrapped:
+        def __call__(self):
+            pass
+
+        @property
+        def __wrapped_listener__(self):
+            raise RuntimeError('wrapped boom')
+
+    e2 = native.EventEmitter()
+    e2.on('z', RaisingWrapped())
+    with pytest.raises(RuntimeError, match='wrapped boom'):
+        e2.count_external('z')
+
+    class InnerRaises:
+        @property
+        def _cueball_internal(self):
+            raise RuntimeError('inner boom')
+
+    class WrappedInnerRaises:
+        __wrapped_listener__ = InnerRaises()
+
+        def __call__(self):
+            pass
+
+    e3 = native.EventEmitter()
+    e3.on('z', WrappedInnerRaises())
+    with pytest.raises(RuntimeError, match='inner boom'):
+        e3.count_external('z')
