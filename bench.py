@@ -188,7 +188,7 @@ def bench_telemetry_step():
         out = step(*args)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
-    n_pools = args[1].shape[0]
+    n_pools = args[1].samples.shape[0]
     return n_pools * iters / dt, str(jax.devices()[0])
 
 

@@ -9,6 +9,7 @@ framework ships its own minimal asyncio HTTP endpoint:
     GET /kang/types             - ['pool', 'set', 'dns_res']
     GET /kang/objects/<type>    - ids of registered objects of a type
     GET /kang/obj/<type>/<id>   - one object's snapshot
+    GET /kang/fleet             - attached FleetSampler's batched decisions
     GET /metrics                - prometheus text metrics (collector)
 """
 
@@ -52,6 +53,9 @@ async def _serve_client(reader, writer, collector=None):
             elif path.startswith('/kang/obj/'):
                 _, _, _, t, id_ = path.split('/', 4)
                 body = json.dumps(pool_monitor.get(t, id_),
+                                  default=_json_default).encode()
+            elif path == '/kang/fleet':
+                body = json.dumps(pool_monitor.fleet_snapshot(),
                                   default=_json_default).encode()
             elif path == '/metrics' and collector is not None:
                 body = collector.collect().encode()

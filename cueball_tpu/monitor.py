@@ -20,6 +20,28 @@ class PoolMonitor:
         self.pm_pools: dict[str, object] = {}
         self.pm_sets: dict[str, object] = {}
         self.pm_dns_res: dict[str, object] = {}
+        self.pm_fleet = None  # attached FleetSampler, if any
+
+    # -- fleet telemetry bridge ------------------------------------------
+
+    def attach_fleet_sampler(self, sampler) -> None:
+        """Publish a FleetSampler's batched decisions through the kang
+        surface (snapshot()['fleet'] and GET /kang/fleet)."""
+        self.pm_fleet = sampler
+
+    attachFleetSampler = attach_fleet_sampler
+
+    def detach_fleet_sampler(self) -> None:
+        self.pm_fleet = None
+
+    detachFleetSampler = detach_fleet_sampler
+
+    def fleet_snapshot(self) -> dict:
+        if self.pm_fleet is None:
+            return {'attached': False}
+        snap = self.pm_fleet.snapshot()
+        snap['attached'] = True
+        return snap
 
     # -- registration (reference lib/pool-monitor.js:27-58) --------------
 
@@ -192,6 +214,8 @@ class PoolMonitor:
         for t in self.list_types():
             out['types'][t] = {
                 id_: self.get(t, id_) for id_ in self.list_objects(t)}
+        if self.pm_fleet is not None:
+            out['fleet'] = self.fleet_snapshot()
         return out
 
 

@@ -27,6 +27,20 @@ def backoff_schedule(delay, max_delay, retries: int):
 
 
 @jax.jit
+def backoff_at(delay, max_delay, attempt):
+    """Current base delay after `attempt` backoff entries:
+    min(delay * 2^attempt, max_delay) — exactly the value
+    SocketMgrFSM.sm_delay holds after entering state_backoff `attempt`
+    times with finite retries (reference lib/connection-fsm.js:372-380;
+    cueball_tpu/connection_fsm.py state_backoff doubles-and-caps).
+    Elementwise over [N] fleets of slots/pools."""
+    delay = jnp.asarray(delay, jnp.float32)
+    max_delay = jnp.asarray(max_delay, jnp.float32)
+    attempt = jnp.asarray(attempt, jnp.float32)
+    return jnp.minimum(delay * jnp.exp2(attempt), max_delay)
+
+
+@jax.jit
 def spread_delays(base, spread, uniforms):
     """Apply the randomized spread: base * (1 - spread/2 + u * spread),
     u ~ U(0,1) supplied by the caller (reference lib/utils.js:446-461;

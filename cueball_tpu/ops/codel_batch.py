@@ -54,8 +54,11 @@ def _step(target: jax.Array, state: CodelState, inputs):
         enter,
         jnp.where(recent & (count_a > 2), count_a - 2, 1.0),
         count_a)
+    # drop_next moves only on entering a dropping run; an in-run drop
+    # bumps count but NOT drop_next (reference lib/codel.js:62-68 —
+    # deliberately not classic CoDel, which would reschedule here).
     drop_next = jnp.where(
-        enter | drop_in_run,
+        enter,
         now + CODEL_INTERVAL / jnp.sqrt(jnp.maximum(count_b, 1.0)),
         state.drop_next)
 

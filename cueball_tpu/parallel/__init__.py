@@ -3,14 +3,21 @@
 SURVEY.md §7.1 is explicit: the reference has no tensor programs, so
 there is no training step to shard. What a TPU host running this
 framework *does* have at scale is control-plane telemetry: thousands of
-pools' load samples and claim-queue sojourns. parallel.telemetry batches
-the framework's control laws (FIR shrink damping, rebalance targeting,
-CoDel) into one jitted step, sharded over a `jax.sharding.Mesh` 'pools'
-axis, with the fleet-wide aggregates (mean load, overload fraction)
-becoming XLA all-reduces over ICI.
+pools' load samples, claim-queue sojourns, and retry-backoff ladders.
+parallel.telemetry batches the framework's control laws (FIR shrink
+damping, rebalance targeting, CoDel, backoff) into one jitted step,
+sharded over a `jax.sharding.Mesh` 'pools' axis, with the fleet-wide
+aggregates (mean load, overload fraction, retry pressure) becoming XLA
+all-reduces over ICI. parallel.sampler bridges the live runtime into
+that step: it samples every pool registered in the process-global
+monitor each LP tick and publishes the batched decisions.
 """
 
-from .telemetry import (FleetState, fleet_init, fleet_step,
-                        make_sharded_step)
+from .sampler import FleetSampler
+from .telemetry import (FleetInputs, FleetState, fleet_init,
+                        fleet_inputs, fleet_step, make_sharded_step,
+                        make_shardmap_step, shard_inputs, shard_state)
 
-__all__ = ['FleetState', 'fleet_init', 'fleet_step', 'make_sharded_step']
+__all__ = ['FleetInputs', 'FleetSampler', 'FleetState', 'fleet_init',
+           'fleet_inputs', 'fleet_step', 'make_sharded_step',
+           'make_shardmap_step', 'shard_inputs', 'shard_state']

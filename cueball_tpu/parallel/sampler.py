@@ -1,0 +1,291 @@
+"""Live bridge: registered pools -> batched TPU telemetry step.
+
+The reference runs each pool's control laws per-pool, in-process, on a
+5 Hz timer (reference lib/pool.js:251-262). The FleetSampler batches
+that loop: every tick it gathers, from every ConnectionPool registered
+in the process-global :data:`cueball_tpu.monitor.pool_monitor`, exactly
+the signals the pool's own Python laws consume —
+
+- the LP load sample ``busy + spares`` (same formula as
+  ``ConnectionPool._lp_sample``),
+- the head-of-claim-queue sojourn and CoDel target,
+- the deepest slot backoff position (``sm_min_delay``/``sm_delay``
+  ladder of SocketMgrFSM),
+
+— runs the jitted :func:`~cueball_tpu.parallel.telemetry.fleet_step`
+over the whole fleet at once, and publishes the per-pool decisions and
+fleet aggregates through the kang snapshot (``/kang/fleet``) and the
+prometheus collector (``cueball_fleet_*`` gauges).
+
+The batched laws are the *same* laws the pools run per-claim in Python;
+``tests/test_sampler.py`` asserts element-for-element agreement between
+the two on live pools under load.
+
+Rows: pools get stable rows in fixed-capacity arrays (capacity doubles
+as the fleet grows, which is the only recompile); departed pools free
+their row and the `reset` mask clears carried filter/CoDel state when
+a row is reassigned.
+"""
+
+from __future__ import annotations
+
+import math
+import typing
+
+from .. import utils as mod_utils
+from ..events import EventEmitter
+from ..monitor import pool_monitor as default_monitor
+
+if typing.TYPE_CHECKING:
+    from ..metrics import Collector
+
+SAMPLER_INT = 200  # ms, the pools' own LP cadence (lib/pool.js:251)
+
+# Rebase the epoch-relative clock before float32 resolution decays:
+# at 2^20 ms (~17 min) the f32 ulp is 0.0625 ms, ample for the 100 ms
+# CoDel control interval. MARGIN keeps post-rebase `now` large enough
+# that clamped-stale timestamps keep their "very old" semantics.
+EPOCH_LIMIT = float(2 ** 20)
+EPOCH_MARGIN = 1000.0
+
+_FLEET_GAUGES = {
+    'n_pools': 'pools currently sampled into the fleet step',
+    'mean_load': 'mean busy+spares load across the fleet',
+    'mean_filtered': 'mean FIR-filtered load across the fleet',
+    'overload_frac': 'fraction of pools with a CoDel drop this tick',
+    'max_sojourn': 'worst head-of-queue claim sojourn (ms)',
+    'retry_frac': 'fraction of pools with slots in retry backoff',
+    'mean_retry_backoff': 'mean reproduced backoff delay (ms)',
+}
+
+
+class FleetSampler:
+    """Samples every registered pool into the batched telemetry step.
+
+    Options (all optional):
+    - monitor: a PoolMonitor (default: the process-global singleton)
+    - interval: tick period in ms (default 200 = the LP cadence)
+    - taps: FIR window length (default 128, the pool's own filter)
+    - capacity: initial row capacity (default 8; grows by doubling)
+    - collector: a metrics Collector to publish cueball_fleet_* gauges
+    - record: keep a per-tick history of inputs/outputs (for tests)
+    """
+
+    def __init__(self, options: dict | None = None):
+        options = options or {}
+        self.fs_monitor = options.get('monitor') or default_monitor
+        self.fs_interval = options.get('interval') or SAMPLER_INT
+        self.fs_taps = options.get('taps') or 128
+        self.fs_capacity = options.get('capacity') or 8
+        self.fs_collector: 'Collector | None' = options.get('collector')
+        self.fs_record = bool(options.get('record'))
+
+        self.fs_epoch = mod_utils.current_millis()
+        self.fs_rows: dict[str, int] = {}      # pool uuid -> row
+        self.fs_free: list[int] = list(range(self.fs_capacity))
+        self.fs_pending_reset: set[int] = set()
+        self.fs_state = None                   # FleetState (lazy)
+        self.fs_latest: dict | None = None
+        self.fs_history: list[dict] = []
+        self.fs_ticks = 0
+        self.fs_timer = None
+        self.fs_emitter = EventEmitter()
+        self.fs_emitter.on('timeout', self.sample_once)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        """Warm up the jitted step (one synchronous tick pays the
+        compile) and begin ticking on the loop."""
+        if self.fs_timer is not None:
+            return
+        from ..pool import _Interval
+        self.sample_once()
+        self.fs_timer = _Interval(self.fs_interval, self.fs_emitter)
+
+    def stop(self) -> None:
+        if self.fs_timer is not None:
+            self.fs_timer.cancel()
+            self.fs_timer = None
+
+    # -- row management --------------------------------------------------
+
+    def _ensure_state(self):
+        from .telemetry import fleet_init
+        if self.fs_state is None:
+            self.fs_state = fleet_init(self.fs_capacity, taps=self.fs_taps)
+        return self.fs_state
+
+    def _grow(self, need: int) -> None:
+        import jax.numpy as jnp
+        from ..ops.codel_batch import CodelState
+        from .telemetry import FleetState
+        old = self.fs_capacity
+        cap = old
+        while cap < need:
+            cap *= 2
+        st = self._ensure_state()
+        pad = cap - old
+        self.fs_state = FleetState(
+            windows=jnp.pad(st.windows, ((0, pad), (0, 0))),
+            codel=CodelState(
+                first_above=jnp.pad(st.codel.first_above, (0, pad)),
+                drop_next=jnp.pad(st.codel.drop_next, (0, pad)),
+                count=jnp.pad(st.codel.count, (0, pad)),
+                dropping=jnp.pad(st.codel.dropping, (0, pad))),
+            now_ms=st.now_ms)
+        self.fs_free.extend(range(old, cap))
+        self.fs_capacity = cap
+
+    def _assign_rows(self, pools: dict[str, object]) -> None:
+        for uuid in [u for u in self.fs_rows if u not in pools]:
+            row = self.fs_rows.pop(uuid)
+            self.fs_free.append(row)
+        fresh = [u for u in pools if u not in self.fs_rows]
+        if len(self.fs_rows) + len(fresh) > self.fs_capacity:
+            self._grow(len(self.fs_rows) + len(fresh))
+        for uuid in fresh:
+            row = self.fs_free.pop(0)
+            self.fs_rows[uuid] = row
+            self.fs_pending_reset.add(row)
+
+    # -- gathering -------------------------------------------------------
+
+    @staticmethod
+    def gather_pool(pool, now: float) -> dict:
+        """One pool's tick signals, using the pools' own formulas.
+
+        sample: identical to ConnectionPool._lp_sample (busy + spares
+        option). sojourn: first still-waiting claim's queue time.
+        retry_*: the deepest backoff slot's ladder position, from which
+        the batched law reproduces its current sm_delay."""
+        sample = pool.lp_load_sample()
+
+        sojourn = 0.0
+        for hdl in pool.p_waiters:
+            if hdl.is_in_state('waiting'):
+                sojourn = now - hdl.ch_started
+                break
+
+        target_delay = math.inf
+        if pool.p_codel is not None:
+            target_delay = float(pool.p_codel.cd_targdelay)
+
+        n_retrying = 0
+        attempt = 0.0
+        delay0 = 0.0
+        max_delay = 0.0
+        for slots in pool.p_connections.values():
+            for slot in slots:
+                smgr = slot.get_socket_mgr()
+                if not smgr.is_in_state('backoff'):
+                    continue
+                if not math.isfinite(smgr.sm_retries):
+                    continue  # monitor slots: pinned, not a ladder
+                n_retrying += 1
+                a = float(smgr.sm_retries - smgr.sm_retries_left)
+                if a >= attempt:
+                    attempt = a
+                    delay0 = float(smgr.sm_min_delay)
+                    max_delay = float(smgr.sm_max_delay)
+        return {
+            'sample': float(sample), 'sojourn': float(sojourn),
+            'target_delay': target_delay,
+            'spares': float(pool.p_spares),
+            'maximum': float(pool.p_max),
+            'retry_delay': delay0, 'retry_max_delay': max_delay,
+            'retry_attempt': attempt, 'n_retrying': float(n_retrying),
+        }
+
+    def sample_once(self) -> dict | None:
+        """One synchronous tick: gather, step, publish. Returns the
+        published record (None when sampling is impossible)."""
+        import numpy as np
+        from .telemetry import FleetInputs, fleet_step
+
+        pools = dict(self.fs_monitor.pm_pools)
+        self._assign_rows(pools)
+        abs_now = mod_utils.current_millis()
+        now = abs_now - self.fs_epoch
+        if now > EPOCH_LIMIT:
+            from .telemetry import rebase_state
+            shift = now - EPOCH_MARGIN
+            self.fs_state = rebase_state(self._ensure_state(), shift)
+            self.fs_epoch += shift
+            now -= shift
+        cap = self.fs_capacity
+
+        f32 = lambda: np.zeros((cap,), np.float32)  # noqa: E731
+        cols = {k: f32() for k in (
+            'samples', 'sojourns', 'spares', 'maximum', 'retry_delay',
+            'retry_max_delay', 'retry_attempt', 'n_retrying')}
+        cols['target_delay'] = np.full((cap,), np.inf, np.float32)
+        active = np.zeros((cap,), bool)
+        reset = np.zeros((cap,), bool)
+        for row in self.fs_pending_reset:
+            reset[row] = True
+        self.fs_pending_reset.clear()
+
+        gathered = {}
+        for uuid, pool in pools.items():
+            row = self.fs_rows[uuid]
+            g = self.gather_pool(pool, abs_now)
+            gathered[uuid] = (row, g)
+            active[row] = True
+            cols['samples'][row] = g['sample']
+            cols['sojourns'][row] = g['sojourn']
+            cols['target_delay'][row] = g['target_delay']
+            cols['spares'][row] = g['spares']
+            cols['maximum'][row] = g['maximum']
+            cols['retry_delay'][row] = g['retry_delay']
+            cols['retry_max_delay'][row] = g['retry_max_delay']
+            cols['retry_attempt'][row] = g['retry_attempt']
+            cols['n_retrying'][row] = g['n_retrying']
+
+        inp = FleetInputs(active=active, reset=reset,
+                          now_ms=np.float32(now), **cols)
+        state = self._ensure_state()
+        new_state, out, fleet = fleet_step(state, inp)
+        self.fs_state = new_state
+        self.fs_ticks += 1
+
+        fleet_np = {k: float(v) for k, v in fleet.items()}
+        out_np = {k: np.asarray(v) for k, v in out.items()}
+        per_pool = {}
+        for uuid, (row, g) in gathered.items():
+            # target_delay=inf means "CoDel off" in the arrays; publish
+            # None instead (Infinity is not valid JSON and the kang
+            # surface is read by strict external parsers).
+            pub = dict(g)
+            if not math.isfinite(pub['target_delay']):
+                pub['target_delay'] = None
+            per_pool[uuid] = {
+                'row': row,
+                'inputs': pub,
+                'filtered': float(out_np['filtered'][row]),
+                'target': float(out_np['target'][row]),
+                'clamped': bool(out_np['clamped'][row]),
+                'drop': bool(out_np['drop'][row]),
+                'retry_backoff': float(out_np['retry_backoff'][row]),
+            }
+        record = {'tick': self.fs_ticks, 'now_ms': now,
+                  'fleet': fleet_np, 'pools': per_pool}
+        self.fs_latest = record
+        if self.fs_record:
+            self.fs_history.append(record)
+        if self.fs_collector is not None:
+            for name, help_ in _FLEET_GAUGES.items():
+                self.fs_collector.gauge(
+                    'cueball_fleet_' + name, help_).set(fleet_np[name])
+        return record
+
+    # -- kang integration ------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            'interval_ms': self.fs_interval,
+            'capacity': self.fs_capacity,
+            'ticks': self.fs_ticks,
+            'rows': dict(self.fs_rows),
+            'latest': self.fs_latest,
+        }

@@ -1,28 +1,39 @@
 """Fleet telemetry step: the framework's control laws, batched + sharded.
 
-One step consumes, for every pool in a fleet:
+One step consumes, for every pool in a fleet (gathered live by
+:class:`cueball_tpu.parallel.sampler.FleetSampler` from the process-global
+pool monitor), the same signals each pool's own Python control laws see:
+
 - a load sample (busy + spares, what the 5 Hz LP timer feeds per pool,
   reference lib/pool.js:251-262)
-- the current claim-queue sojourn (ms)
+- the head-of-queue claim sojourn (ms) and CoDel target
+- the deepest retry-backoff position among the pool's slots
+  (reference lib/connection-fsm.js:361-394)
+- the pool's own spares / maximum settings
 
 and produces, per pool:
+
 - the FIR-filtered load (128-tap EMA, reference lib/pool.js:44-100)
 - the clamped rebalance target (reference lib/pool.js:573-592)
 - the CoDel drop decision (reference lib/codel.js)
+- the reproduced backoff delay (reference lib/connection-fsm.js:372-380)
 
-plus fleet-wide aggregates (mean load, overload fraction) that become
-XLA all-reduces when the pools axis is sharded over a Mesh.
+plus fleet-wide aggregates (mean load, overload fraction, retry
+pressure) that become XLA all-reduces when the pools axis is sharded
+over a Mesh. Rows are a fixed-capacity [P] axis so jit traces once per
+capacity; `active` masks unoccupied rows out of the aggregates and
+`reset` clears carried state when a row is reassigned to a new pool.
 """
 
 from __future__ import annotations
 
-import functools
 import typing
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.backoff import backoff_at
 from ..ops.codel_batch import CodelState, codel_init, _step as codel_step
 from ..ops.fir import fir_apply, gen_taps
 
@@ -30,7 +41,23 @@ from ..ops.fir import fir_apply, gen_taps
 class FleetState(typing.NamedTuple):
     windows: jax.Array      # [pools, taps] load sample ring (old->new)
     codel: CodelState       # [pools] CoDel control state
-    now_ms: jax.Array       # scalar clock
+    now_ms: jax.Array       # scalar clock of the last step
+
+
+class FleetInputs(typing.NamedTuple):
+    """One tick's worth of per-pool samples (all [P] unless noted)."""
+    samples: jax.Array          # busy + spares load sample
+    sojourns: jax.Array         # head-of-claim-queue sojourn (ms)
+    target_delay: jax.Array     # CoDel target (ms); +inf = CoDel off
+    spares: jax.Array           # pool `spares` option
+    maximum: jax.Array          # pool `maximum` option
+    retry_delay: jax.Array      # base recovery delay of deepest slot
+    retry_max_delay: jax.Array  # its maxDelay cap
+    retry_attempt: jax.Array    # its backoff-entry count
+    n_retrying: jax.Array       # slots currently in backoff
+    active: jax.Array           # bool: row occupied by a live pool
+    reset: jax.Array            # bool: row newly (re)assigned
+    now_ms: jax.Array           # scalar monotonic clock (ms)
 
 
 def fleet_init(n_pools: int, taps: int = 128) -> FleetState:
@@ -40,49 +67,138 @@ def fleet_init(n_pools: int, taps: int = 128) -> FleetState:
         now_ms=jnp.float32(0.0))
 
 
-@functools.partial(jax.jit, static_argnames=('spares', 'maximum'))
-def fleet_step(state: FleetState, samples: jax.Array,
-               sojourns: jax.Array, target_delay: jax.Array,
-               spares: int = 4, maximum: int = 16):
-    """One telemetry tick for the whole fleet.
+def fleet_inputs(n_pools: int, **kw) -> FleetInputs:
+    """A FleetInputs of idle defaults (inactive rows, CoDel off);
+    override any field by keyword."""
+    z = jnp.zeros((n_pools,), jnp.float32)
+    vals = dict(
+        samples=z, sojourns=z,
+        target_delay=jnp.full((n_pools,), jnp.inf, jnp.float32),
+        spares=z, maximum=jnp.full((n_pools,), 16.0, jnp.float32),
+        retry_delay=z, retry_max_delay=z, retry_attempt=z,
+        n_retrying=z,
+        active=jnp.zeros((n_pools,), bool),
+        reset=jnp.zeros((n_pools,), bool),
+        now_ms=jnp.float32(0.0))
+    vals.update(kw)
+    return FleetInputs(**{k: jnp.asarray(v) for k, v in vals.items()})
 
-    samples: [pools] current busy+spares load; sojourns: [pools] claim
-    sojourn ms; target_delay: [pools] per-pool CoDel target ms.
-    """
-    taps = gen_taps(state.windows.shape[1])
 
+def _local_step(state: FleetState, inp: FleetInputs):
+    """Per-pool control laws — embarrassingly parallel over the pools
+    axis (identical whether run on full arrays or one shard)."""
+    rst = inp.reset
+    windows = jnp.where(rst[:, None], 0.0, state.windows)
+    codel0 = CodelState(
+        first_above=jnp.where(rst, 0.0, state.codel.first_above),
+        drop_next=jnp.where(rst, 0.0, state.codel.drop_next),
+        count=jnp.where(rst, 0.0, state.codel.count),
+        dropping=jnp.where(rst, False, state.codel.dropping))
+
+    taps = gen_taps(windows.shape[1])
     windows = jnp.concatenate(
-        [state.windows[:, 1:], samples[:, None]], axis=1)
+        [windows[:, 1:], inp.samples[:, None]], axis=1)
     filtered = fir_apply(windows, taps)
 
     # Rebalance target with LP clamp (reference lib/pool.js:573-592):
     # shrink no faster than the filtered recent load allows.
-    raw_target = samples + spares
+    raw_target = inp.samples + inp.spares
     lp_min = jnp.ceil(filtered)
     clamped = raw_target < lp_min * 1.05
     target = jnp.where(clamped, lp_min, raw_target)
-    target = jnp.minimum(target, maximum)
+    target = jnp.minimum(target, inp.maximum)
 
-    now = state.now_ms + 200.0  # 5 Hz tick
     codel_state, drops = codel_step(
-        target_delay, state.codel, (now, sojourns))
+        inp.target_delay, codel0, (inp.now_ms, inp.sojourns))
 
-    # Fleet aggregates: all-reduces over the sharded pools axis.
-    fleet = {
-        'mean_load': jnp.mean(samples),
-        'mean_filtered': jnp.mean(filtered),
-        'overload_frac': jnp.mean(drops.astype(jnp.float32)),
-        'max_sojourn': jnp.max(sojourns),
-    }
+    # Reproduced per-pool backoff delay of the deepest retrying slot
+    # (reference lib/connection-fsm.js:372-380 double-and-cap ladder).
+    has_retry = inp.n_retrying > 0
+    retry_backoff = jnp.where(
+        has_retry,
+        backoff_at(inp.retry_delay, inp.retry_max_delay,
+                   inp.retry_attempt),
+        0.0)
 
     new_state = FleetState(windows=windows, codel=codel_state,
-                           now_ms=now)
+                           now_ms=inp.now_ms)
     out = {'filtered': filtered, 'target': target,
-           'clamped': clamped, 'drop': drops}
+           'clamped': clamped, 'drop': drops,
+           'retry_backoff': retry_backoff}
+    return new_state, out
+
+
+def _partial_sums(inp: FleetInputs, out: dict) -> dict:
+    """Shard-local reduction terms for the fleet aggregates, masked to
+    occupied rows. Combined across shards by sum (psum) except
+    'max_sojourn' (pmax)."""
+    act = inp.active.astype(jnp.float32)
+    retrying = (inp.n_retrying > 0).astype(jnp.float32) * act
+    return {
+        'n': jnp.sum(act),
+        'load': jnp.sum(inp.samples * act),
+        'filtered': jnp.sum(out['filtered'] * act),
+        'drops': jnp.sum(out['drop'].astype(jnp.float32) * act),
+        'n_retry': jnp.sum(retrying),
+        'backoff': jnp.sum(out['retry_backoff'] * retrying),
+        'max_sojourn': jnp.max(
+            jnp.where(inp.active, inp.sojourns, 0.0)),
+    }
+
+
+def _finalize(p: dict) -> dict:
+    n = jnp.maximum(p['n'], 1.0)
+    n_retry = jnp.maximum(p['n_retry'], 1.0)
+    return {
+        'n_pools': p['n'],
+        'mean_load': p['load'] / n,
+        'mean_filtered': p['filtered'] / n,
+        'overload_frac': p['drops'] / n,
+        'max_sojourn': p['max_sojourn'],
+        'retry_frac': p['n_retry'] / n,
+        'mean_retry_backoff': p['backoff'] / n_retry,
+    }
+
+
+@jax.jit
+def fleet_step(state: FleetState, inp: FleetInputs):
+    """One telemetry tick for the whole fleet (single-device or GSPMD).
+
+    Returns (new_state, per_pool_outputs, fleet_aggregates)."""
+    new_state, out = _local_step(state, inp)
+    fleet = _finalize(_partial_sums(inp, out))
     return new_state, out, fleet
 
 
-def make_sharded_step(mesh: Mesh, spares: int = 4, maximum: int = 16):
+@jax.jit
+def rebase_state(state: FleetState, shift) -> FleetState:
+    """Shift the CoDel timestamp clocks back by `shift` ms.
+
+    The batched step keeps time in float32; feeding it an absolute
+    monotonic clock (~1e9 ms on a long-lived host) would round to
+    ~64 ms — worse than the 100 ms CoDel control interval. The sampler
+    therefore runs an epoch-relative clock and periodically rebases the
+    carried state. Timestamps older than the shift clamp to 1 ms, which
+    preserves both CoDel uses of an old timestamp (`now >= t` and
+    `now - t >= INTERVAL`) as long as the post-rebase `now` stays above
+    INTERVAL + 1 — the sampler rebases with a 1 s margin. The 0
+    sentinel ("unset") is preserved exactly."""
+    shift = jnp.float32(shift)
+    fa = state.codel.first_above
+    dn = state.codel.drop_next
+    return FleetState(
+        windows=state.windows,
+        codel=CodelState(
+            first_above=jnp.where(
+                fa > 0.0, jnp.maximum(fa - shift, 1.0), 0.0),
+            drop_next=jnp.where(
+                dn > 0.0, jnp.maximum(dn - shift, 1.0), dn),
+            count=state.codel.count,
+            dropping=state.codel.dropping),
+        now_ms=jnp.maximum(state.now_ms - shift, 0.0))
+
+
+def make_sharded_step(mesh: Mesh):
     """Build a jitted step with every [pools, ...] array sharded over
     the mesh's 'pools' axis. The per-pool math is embarrassingly
     parallel (no resharding); the fleet aggregates compile to psum-style
@@ -96,18 +212,69 @@ def make_sharded_step(mesh: Mesh, spares: int = 4, maximum: int = 16):
         codel=CodelState(pool_sharding, pool_sharding, pool_sharding,
                          pool_sharding),
         now_ms=scalar)
+    input_shardings = FleetInputs(
+        samples=pool_sharding, sojourns=pool_sharding,
+        target_delay=pool_sharding, spares=pool_sharding,
+        maximum=pool_sharding, retry_delay=pool_sharding,
+        retry_max_delay=pool_sharding, retry_attempt=pool_sharding,
+        n_retrying=pool_sharding, active=pool_sharding,
+        reset=pool_sharding, now_ms=scalar)
     out_shardings = (
         state_shardings,
         {'filtered': pool_sharding, 'target': pool_sharding,
-         'clamped': pool_sharding, 'drop': pool_sharding},
-        {'mean_load': scalar, 'mean_filtered': scalar,
-         'overload_frac': scalar, 'max_sojourn': scalar})
+         'clamped': pool_sharding, 'drop': pool_sharding,
+         'retry_backoff': pool_sharding},
+        {'n_pools': scalar, 'mean_load': scalar, 'mean_filtered': scalar,
+         'overload_frac': scalar, 'max_sojourn': scalar,
+         'retry_frac': scalar, 'mean_retry_backoff': scalar})
 
-    return jax.jit(
-        functools.partial(fleet_step, spares=spares, maximum=maximum),
-        in_shardings=(state_shardings, pool_sharding, pool_sharding,
-                      pool_sharding),
-        out_shardings=out_shardings)
+    return jax.jit(fleet_step,
+                   in_shardings=(state_shardings, input_shardings),
+                   out_shardings=out_shardings)
+
+
+def make_shardmap_step(mesh: Mesh):
+    """The SPMD form of :func:`fleet_step`: shard_map over the 'pools'
+    mesh axis with hand-written collectives — per-pool laws run on the
+    local shard, fleet aggregates are jax.lax.psum / pmax over ICI.
+
+    Semantically identical to fleet_step; the multichip dryrun asserts
+    so (a wrong collective here genuinely fails the allclose, unlike
+    GSPMD annotations which XLA always resolves to correct programs)."""
+    from jax.experimental.shard_map import shard_map
+
+    pool = P('pools')
+    window = P('pools', None)
+    scalar = P()
+
+    state_specs = FleetState(
+        windows=window,
+        codel=CodelState(pool, pool, pool, pool),
+        now_ms=scalar)
+    input_specs = FleetInputs(
+        samples=pool, sojourns=pool, target_delay=pool, spares=pool,
+        maximum=pool, retry_delay=pool, retry_max_delay=pool,
+        retry_attempt=pool, n_retrying=pool, active=pool, reset=pool,
+        now_ms=scalar)
+    out_specs = (
+        state_specs,
+        {'filtered': pool, 'target': pool, 'clamped': pool,
+         'drop': pool, 'retry_backoff': pool},
+        {'n_pools': scalar, 'mean_load': scalar, 'mean_filtered': scalar,
+         'overload_frac': scalar, 'max_sojourn': scalar,
+         'retry_frac': scalar, 'mean_retry_backoff': scalar})
+
+    def local(state, inp):
+        new_state, out = _local_step(state, inp)
+        p = _partial_sums(inp, out)
+        p = {k: (jax.lax.pmax(v, 'pools') if k == 'max_sojourn'
+                 else jax.lax.psum(v, 'pools'))
+             for k, v in p.items()}
+        return new_state, out, _finalize(p)
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(state_specs, input_specs),
+        out_specs=out_specs))
 
 
 def shard_state(state: FleetState, mesh: Mesh) -> FleetState:
@@ -119,3 +286,11 @@ def shard_state(state: FleetState, mesh: Mesh) -> FleetState:
         codel=CodelState(
             *[jax.device_put(x, pool_sharding) for x in state.codel]),
         now_ms=jax.device_put(state.now_ms, scalar))
+
+
+def shard_inputs(inp: FleetInputs, mesh: Mesh) -> FleetInputs:
+    pool_sharding = NamedSharding(mesh, P('pools'))
+    scalar = NamedSharding(mesh, P())
+    return FleetInputs(
+        *[jax.device_put(x, pool_sharding) for x in inp[:-1]],
+        now_ms=jax.device_put(inp.now_ms, scalar))

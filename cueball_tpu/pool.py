@@ -250,11 +250,18 @@ class ConnectionPool(FSM):
 
     # -- internals -------------------------------------------------------
 
-    def _lp_sample(self) -> None:
+    def lp_load_sample(self) -> float:
+        """The load figure the 5 Hz LP filter tracks: busy connections
+        plus the spares setting (reference lib/pool.js:251-262). Shared
+        with the fleet telemetry sampler so the batched law sees exactly
+        what the per-pool law sees."""
         conns = sum(len(v) for v in self.p_connections.values())
         spares = len(self.p_idleq) + len(self.p_initq)
         busy = conns - spares
-        self.p_lpf.put(busy + self.p_spares)
+        return busy + self.p_spares
+
+    def _lp_sample(self) -> None:
+        self.p_lpf.put(self.lp_load_sample())
         if self.p_last_rebal_clamped:
             self.rebalance()
 

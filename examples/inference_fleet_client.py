@@ -39,6 +39,22 @@ RECOVERY = {
 }
 
 
+def start_fleet_sampler():
+    """Attach the batched TPU telemetry step to every pool this process
+    creates: one jitted fleet_step samples all registered pools each LP
+    tick and publishes fleet aggregates (kang /kang/fleet + prometheus
+    cueball_fleet_* gauges). Returns None when jax is unavailable."""
+    try:
+        from cueball_tpu.parallel import FleetSampler
+    except ImportError:
+        return None
+    from cueball_tpu.monitor import pool_monitor
+    sampler = FleetSampler({})
+    pool_monitor.attach_fleet_sampler(sampler)
+    sampler.start()
+    return sampler
+
+
 async def run_static(addrs, n_requests, target_claim_delay):
     backends = []
     for a in addrs:
@@ -58,6 +74,7 @@ async def run_static(addrs, n_requests, target_claim_delay):
     agent.create_pool(host, {'resolver': resolver,
                              'targetClaimDelay': target_claim_delay})
     pool = agent.get_pool(host)
+    sampler = start_fleet_sampler()
 
     ok = errs = 0
     per_backend = {}
@@ -73,6 +90,13 @@ async def run_static(addrs, n_requests, target_claim_delay):
     for body, count in sorted(per_backend.items()):
         print('  %4d x %r' % (count, body))
     print('pool stats:', pool.get_stats())
+    if sampler is not None:
+        sampler.stop()
+        sampler.sample_once()  # final tick so short runs report too
+        print('fleet telemetry (batched over %d pool(s)): %s' % (
+            int(sampler.fs_latest['fleet']['n_pools']),
+            {k: round(v, 2)
+             for k, v in sampler.fs_latest['fleet'].items()}))
     await agent.stop()
 
 

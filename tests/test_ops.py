@@ -105,10 +105,20 @@ def test_codel_scan_matches_scalar_codel(monkeypatch):
     assert batched_drops == scalar_drops
 
 
+def test_backoff_at_matches_smgr_current_delay():
+    from cueball_tpu.ops.backoff import backoff_at
+    got = np.asarray(backoff_at(
+        jnp.asarray([100.0, 50.0, 100.0]),
+        jnp.asarray([1500.0, 10000.0, 100.0]),
+        jnp.asarray([4.0, 3.0, 0.0])))
+    np.testing.assert_allclose(got, [1500.0, 400.0, 100.0])
+
+
 def test_sharded_fleet_step_on_mesh():
     from jax.sharding import Mesh
-    from cueball_tpu.parallel import fleet_init, make_sharded_step
-    from cueball_tpu.parallel.telemetry import shard_state
+    from cueball_tpu.parallel import (fleet_init, fleet_inputs,
+                                      make_sharded_step)
+    from cueball_tpu.parallel.telemetry import shard_inputs, shard_state
 
     devs = np.array(jax.devices()[:8])
     assert len(devs) == 8, 'conftest should force 8 cpu devices'
@@ -116,22 +126,72 @@ def test_sharded_fleet_step_on_mesh():
 
     n = 64
     state = shard_state(fleet_init(n, taps=128), mesh)
-    step = make_sharded_step(mesh, spares=2, maximum=8)
+    step = make_sharded_step(mesh)
 
     rng = np.random.default_rng(9)
-    samples = jnp.asarray(rng.uniform(0, 6, size=n), jnp.float32)
-    sojourns = jnp.asarray(rng.uniform(0, 400, size=n), jnp.float32)
-    tgt = jnp.full((n,), 200.0, jnp.float32)
+    inp = fleet_inputs(
+        n,
+        samples=jnp.asarray(rng.uniform(0, 6, size=n), jnp.float32),
+        sojourns=jnp.asarray(rng.uniform(0, 400, size=n), jnp.float32),
+        target_delay=jnp.full((n,), 200.0, jnp.float32),
+        spares=jnp.full((n,), 2.0, jnp.float32),
+        maximum=jnp.full((n,), 8.0, jnp.float32),
+        active=jnp.ones((n,), bool),
+        now_ms=jnp.float32(200.0))
+    inp = shard_inputs(inp, mesh)
 
-    state, out, fleet = step(state, samples, sojourns, tgt)
+    state, out, fleet = step(state, inp)
     assert out['target'].shape == (n,)
     assert float(fleet['mean_load']) == pytest.approx(
-        float(jnp.mean(samples)), rel=1e-5)
+        float(jnp.mean(inp.samples)), rel=1e-5)
+    assert float(fleet['n_pools']) == n
     assert 0.0 <= float(fleet['overload_frac']) <= 1.0
     # targets never exceed the maximum cap
     assert float(jnp.max(out['target'])) <= 8.0
 
     # Run a few more steps; the filtered estimate tracks the load.
-    for _ in range(10):
-        state, out, fleet = step(state, samples, sojourns, tgt)
+    for k in range(10):
+        inp = inp._replace(now_ms=jnp.float32(200.0 * (k + 2)))
+        state, out, fleet = step(state, shard_inputs(inp, mesh))
     assert np.all(np.asarray(out['filtered']) >= 0)
+
+
+def test_fleet_step_masks_inactive_rows():
+    from cueball_tpu.parallel import fleet_init, fleet_inputs, fleet_step
+
+    n = 8
+    active = np.zeros(n, bool)
+    active[:3] = True
+    samples = np.zeros(n, np.float32)
+    samples[:3] = [2.0, 4.0, 6.0]
+    samples[3:] = 99.0  # garbage in unoccupied rows must not leak
+    inp = fleet_inputs(n, samples=jnp.asarray(samples),
+                       active=jnp.asarray(active),
+                       now_ms=jnp.float32(200.0))
+    _, _, fleet = fleet_step(fleet_init(n), inp)
+    assert float(fleet['n_pools']) == 3
+    assert float(fleet['mean_load']) == pytest.approx(4.0)
+    assert float(fleet['max_sojourn']) == 0.0
+
+
+def test_fleet_step_reset_clears_row_state():
+    from cueball_tpu.parallel import fleet_init, fleet_inputs, fleet_step
+
+    n = 4
+    state = fleet_init(n)
+    inp = fleet_inputs(n, samples=jnp.full((n,), 5.0, jnp.float32),
+                       active=jnp.ones((n,), bool),
+                       now_ms=jnp.float32(200.0))
+    for k in range(140):  # saturate the 128-tap window
+        state, out, _ = fleet_step(
+            state, inp._replace(now_ms=jnp.float32(200.0 * (k + 1))))
+    assert float(out['filtered'][1]) == pytest.approx(5.0, rel=1e-3)
+
+    # Reassign row 1 to a new pool: its window restarts from zeros
+    # while row 0 carries on.
+    reset = np.zeros(n, bool)
+    reset[1] = True
+    state, out, _ = fleet_step(state, inp._replace(
+        reset=jnp.asarray(reset), now_ms=jnp.float32(200.0 * 141)))
+    assert float(out['filtered'][0]) == pytest.approx(5.0, rel=1e-3)
+    assert float(out['filtered'][1]) < 2.0

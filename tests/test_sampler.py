@@ -1,0 +1,356 @@
+"""Live-pool -> batched-telemetry bridge (parallel/sampler.py).
+
+The headline test drives real ConnectionPools under load and asserts
+element-for-element agreement between the batched fleet_step decisions
+and the pools' own Python control laws fed the identical sampled
+sequences: the FIR shrink filter (pool.FIRFilter), the CoDel law
+(codel.ControlledDelay) and the SocketMgr backoff ladder (sm_delay).
+Also covers row lifecycle (grow/recycle/reset), masked aggregates on
+live pools, the kang /kang/fleet + /metrics surface, and the timed
+start()/stop() loop.
+"""
+
+import asyncio
+import math
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+
+from cueball_tpu import codel as mod_codel
+from cueball_tpu import metrics as mod_metrics
+from cueball_tpu.monitor import PoolMonitor, pool_monitor
+from cueball_tpu.parallel.sampler import FleetSampler
+from cueball_tpu.pool import FIRFilter, gen_taps
+
+from conftest import run_async, settle
+from test_pool import Ctx, claim, make_pool
+
+
+def f32(x):
+    return float(np.float32(x))
+
+
+def make_sampler(pools, **opts):
+    """A FleetSampler over a private monitor holding exactly `pools`."""
+    mon = PoolMonitor()
+    for p in pools:
+        mon.register_pool(p)
+    return FleetSampler({'monitor': mon, 'record': True, **opts})
+
+
+def replay_python_laws(history, uuid):
+    """Re-run the pool's own Python control laws over the sampled
+    sequence recorded for `uuid` and return their outputs per tick."""
+    fir = FIRFilter(gen_taps(128, -0.2))
+    cd = None
+    out = []
+    for rec in history:
+        pp = rec['pools'][uuid]
+        g = pp['inputs']
+        # FIR: same put/get the 5 Hz LP timer does (pool._lp_sample).
+        fir.put(f32(g['sample']))
+        filtered = fir.get()
+        # Rebalance target law (pool._rebalance LP clamp).
+        raw = f32(g['sample']) + f32(g['spares'])
+        lp_min = math.ceil(pp['filtered'])  # ceil on the jax filtered
+        if raw < lp_min * 1.05:
+            target = float(lp_min)
+        else:
+            target = raw
+        target = min(target, f32(g['maximum']))
+        # CoDel: the scalar law, on the f32-rounded (now, sojourn).
+        # target_delay None = CoDel off (published form of +inf).
+        drop = False
+        if g['target_delay'] is not None:
+            if cd is None:
+                cd = mod_codel.ControlledDelay(g['target_delay'])
+            now = f32(rec['now_ms'])
+            start = now - f32(g['sojourn'])
+            saved = mod_codel.current_millis
+            mod_codel.current_millis = lambda: now  # noqa: B023
+            try:
+                drop = cd.overloaded(start)
+            finally:
+                mod_codel.current_millis = saved
+        out.append({'filtered': filtered, 'target': target,
+                    'drop': drop})
+    return out
+
+
+def test_sampler_parity_with_python_laws():
+    async def t():
+        ctx = Ctx()
+        # Pool A: CoDel on, 2 conns, claims queue under load.
+        pool_a, inner_a = make_pool(ctx, spares=2, maximum=2,
+                                    targetClaimDelay=300)
+        # Pool B: no CoDel, different spares/maximum.
+        pool_b, inner_b = make_pool(ctx, spares=3, maximum=6)
+        inner_a.emit('added', 'a1', {})
+        inner_b.emit('added', 'b1', {})
+        inner_b.emit('added', 'b2', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+
+        sampler = make_sampler([pool_a, pool_b])
+
+        # Drive load on pool A: hold both conns, queue extra claims.
+        held = []
+        for _ in range(2):
+            fut, _ = claim(pool_a)
+            held.append(await fut)
+        queued = [claim(pool_a) for _ in range(3)]
+
+        for tick in range(30):
+            await asyncio.sleep(0.02)
+            rec = sampler.sample_once()
+            assert set(rec['pools']) == {pool_a.p_uuid, pool_b.p_uuid}
+            # Release/re-claim occasionally so sojourns move.
+            if tick % 7 == 3 and held:
+                hdl, _ = held.pop()
+                hdl.release()
+
+        for fut, waiter in queued:
+            if not fut.done():
+                waiter.cancel()
+        for hdl, _ in held:
+            hdl.release()
+
+        history = sampler.fs_history
+        assert len(history) == 30
+        for uuid, pool in ((pool_a.p_uuid, pool_a),
+                           (pool_b.p_uuid, pool_b)):
+            expect = replay_python_laws(history, uuid)
+            for k, (rec, exp) in enumerate(zip(history, expect)):
+                got = rec['pools'][uuid]
+                assert got['filtered'] == pytest.approx(
+                    exp['filtered'], rel=1e-4, abs=1e-4), (uuid, k)
+                assert got['target'] == pytest.approx(
+                    exp['target'], rel=1e-5), (uuid, k)
+                assert got['drop'] == exp['drop'], (uuid, k)
+            # Pool B has no codel: the batched law must never drop it.
+            if pool.p_codel is None:
+                assert not any(
+                    r['pools'][uuid]['drop'] for r in history)
+
+        # The load actually exercised the laws: pool A queued waiters
+        # produced nonzero sojourns.
+        assert any(r['pools'][pool_a.p_uuid]['inputs']['sojourn'] > 0
+                   for r in history)
+
+        pool_a.stop()
+        pool_b.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_sampler_retry_backoff_matches_smgr():
+    async def t():
+        ctx = Ctx()
+        # Connections never connect; generous retries so slots sit in
+        # backoff climbing the ladder.
+        pool, inner = make_pool(ctx, spares=1, maximum=2, recovery={
+            'default': {'timeout': 30, 'retries': 8, 'delay': 20,
+                        'maxDelay': 160}})
+        inner.emit('added', 'b1', {})
+        sampler = make_sampler([pool])
+
+        deadline = asyncio.get_running_loop().time() + 5.0
+        saw_ladder = []
+        while asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.02)
+            rec = sampler.sample_once()
+            got = rec['pools'][pool.p_uuid]
+            # Read the live smgrs in the same synchronous instant the
+            # sampler did (no awaits in between).
+            deepest = None
+            n_backoff = 0
+            for slots in pool.p_connections.values():
+                for slot in slots:
+                    smgr = slot.get_socket_mgr()
+                    if smgr.is_in_state('backoff') and \
+                            math.isfinite(smgr.sm_retries):
+                        n_backoff += 1
+                        a = smgr.sm_retries - smgr.sm_retries_left
+                        if deepest is None or a >= deepest[0]:
+                            deepest = (a, smgr.sm_delay)
+            if n_backoff == 0:
+                continue
+            # The batched ladder must reproduce the deepest slot's
+            # actual current sm_delay exactly.
+            assert got['inputs']['n_retrying'] == n_backoff
+            assert got['retry_backoff'] == pytest.approx(
+                deepest[1], rel=1e-6)
+            saw_ladder.append(deepest[0])
+            if len(saw_ladder) > 4 and max(saw_ladder) >= 3:
+                break
+        assert saw_ladder, 'no backoff ever observed'
+        assert max(saw_ladder) >= 3, 'ladder never climbed'
+        # The cap engaged at some point (delay ladder: 20,40,80,160).
+        fleet = sampler.fs_latest['fleet']
+        assert fleet['retry_frac'] in (0.0, 1.0)
+
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_sampler_row_recycle_and_masked_aggregates():
+    async def t():
+        ctx = Ctx()
+        pool_a, inner_a = make_pool(ctx, spares=1, maximum=2)
+        pool_b, inner_b = make_pool(ctx, spares=1, maximum=2)
+        inner_a.emit('added', 'a1', {})
+        inner_b.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+
+        sampler = make_sampler([pool_a, pool_b], capacity=2)
+        mon = sampler.fs_monitor
+        for _ in range(10):
+            await asyncio.sleep(0.005)
+            rec = sampler.sample_once()
+        assert rec['fleet']['n_pools'] == 2
+        # mean over exactly the two live pools
+        vals = [rec['pools'][u]['inputs']['sample']
+                for u in (pool_a.p_uuid, pool_b.p_uuid)]
+        assert rec['fleet']['mean_load'] == pytest.approx(
+            sum(vals) / 2, rel=1e-5)
+        row_a = sampler.fs_rows[pool_a.p_uuid]
+        filt_a = rec['pools'][pool_a.p_uuid]['filtered']
+        assert filt_a > 0.2  # window has accumulated load
+
+        # Pool A leaves; a new pool C must inherit its row with a
+        # clean window (reset), while pool B's state carries over.
+        mon.unregister_pool(pool_a)
+        pool_c, inner_c = make_pool(ctx, spares=1, maximum=2)
+        inner_c.emit('added', 'c1', {})
+        await settle()
+        for c in list(ctx.connections):
+            if not c.connected and not c.dead:
+                c.connect()
+        await settle()
+        mon.register_pool(pool_c)
+
+        rec = sampler.sample_once()
+        assert sampler.fs_rows[pool_c.p_uuid] == row_a
+        filt_c = rec['pools'][pool_c.p_uuid]['filtered']
+        # One sample into a zeroed 128-tap window: small, not pool A's
+        # accumulated value.
+        assert filt_c < filt_a
+        assert rec['fleet']['n_pools'] == 2
+
+        # Growth: two more pools force capacity doubling; old rows'
+        # state (pool B) must carry across the pad.
+        filt_b_before = rec['pools'][pool_b.p_uuid]['filtered']
+        pool_d, inner_d = make_pool(ctx, spares=1, maximum=2)
+        mon.register_pool(pool_d)
+        rec = sampler.sample_once()
+        assert sampler.fs_capacity == 4
+        assert rec['fleet']['n_pools'] == 3
+        filt_b_after = rec['pools'][pool_b.p_uuid]['filtered']
+        assert filt_b_after == pytest.approx(filt_b_before, rel=0.2)
+
+        for p in (pool_a, pool_b, pool_c, pool_d):
+            p.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_sampler_start_stop_and_kang_surface():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+
+        collector = mod_metrics.create_collector()
+        sampler = FleetSampler({'interval': 20,
+                                'collector': collector})
+        pool_monitor.attach_fleet_sampler(sampler)
+        try:
+            sampler.start()
+            await asyncio.sleep(0.3)
+            sampler.stop()
+            ticks = sampler.fs_ticks
+            assert ticks >= 3, 'timer loop never ticked'
+            await asyncio.sleep(0.1)
+            assert sampler.fs_ticks == ticks, 'stop() did not stop it'
+
+            # kang snapshot carries the fleet section...
+            snap = pool_monitor.snapshot()
+            assert snap['fleet']['attached'] is True
+            assert snap['fleet']['latest']['fleet']['n_pools'] >= 1
+            assert pool.p_uuid in snap['fleet']['rows']
+
+            # ...and over HTTP, with the prometheus gauges.
+            from cueball_tpu.http_server import serve_monitor
+            server = await serve_monitor(collector=collector)
+            port = server.sockets[0].getsockname()[1]
+            reader, writer = await asyncio.open_connection(
+                '127.0.0.1', port)
+            writer.write(b'GET /kang/fleet HTTP/1.1\r\n\r\n')
+            body = (await reader.read()).split(b'\r\n\r\n', 1)[1]
+            import json
+            fleet = json.loads(body)
+            assert fleet['attached'] is True
+            assert fleet['ticks'] == ticks
+
+            reader, writer = await asyncio.open_connection(
+                '127.0.0.1', port)
+            writer.write(b'GET /metrics HTTP/1.1\r\n\r\n')
+            text = (await reader.read()).decode()
+            assert 'cueball_fleet_mean_load' in text
+            assert 'cueball_fleet_n_pools' in text
+            server.close()
+            await server.wait_closed()
+        finally:
+            pool_monitor.detach_fleet_sampler()
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_rebase_preserves_codel_decisions():
+    """Epoch rebasing must not change the batched CoDel behaviour: the
+    same sojourn trace, with a rebase injected mid-run, produces the
+    same drop sequence as an un-rebased run."""
+    import jax.numpy as jnp
+    from cueball_tpu.parallel import fleet_init, fleet_inputs, fleet_step
+    from cueball_tpu.parallel.telemetry import rebase_state
+
+    rng = np.random.default_rng(11)
+    n = 4
+    base = 5000.0
+    sojourns = rng.uniform(0, 700, size=(40, n)).astype(np.float32)
+
+    def run(with_rebase):
+        state = fleet_init(n)
+        shift_acc = 0.0
+        drops = []
+        for k in range(40):
+            now = base + 200.0 * k - shift_acc
+            if with_rebase and k == 20:
+                shift = now - 2000.0
+                state = rebase_state(state, shift)
+                shift_acc += shift
+                now -= shift
+            inp = fleet_inputs(
+                n, samples=jnp.full((n,), 3.0, jnp.float32),
+                sojourns=jnp.asarray(sojourns[k]),
+                target_delay=jnp.full((n,), 300.0, jnp.float32),
+                active=jnp.ones((n,), bool),
+                now_ms=jnp.float32(now))
+            state, out, _ = fleet_step(state, inp)
+            drops.append(np.asarray(out['drop']).copy())
+        return np.stack(drops)
+
+    np.testing.assert_array_equal(run(False), run(True))
