@@ -232,7 +232,7 @@ class SocketMgrFSM(FSM):
 
     def state_init(self, S):
         S.validTransitions(['connecting'])
-        S.on(self, 'connectAsserted', lambda: S.gotoState('connecting'))
+        S.goto_state_on(self, 'connectAsserted', 'connecting')
 
     def state_connecting(self, S):
         S.validTransitions(['connected', 'error'])
@@ -300,15 +300,15 @@ class SocketMgrFSM(FSM):
             self.sm_pool._incr_counter('error-while-connected')
             self.sm_log.debug('emitted error while connected: %r', err)
         S.on(self.sm_socket, 'error', on_error)
-        S.on(self.sm_socket, 'close', lambda *a: S.gotoState('closed'))
-        S.on(self, 'closeAsserted', lambda: S.gotoState('closed'))
+        S.goto_state_on(self.sm_socket, 'close', 'closed')
+        S.goto_state_on(self, 'closeAsserted', 'closed')
 
     def state_error(self, S):
         S.validTransitions(['backoff'])
         if self.sm_socket is not None:
             self.sm_socket.destroy()
         self.sm_socket = None
-        S.on(self, 'retryAsserted', lambda: S.gotoState('backoff'))
+        S.goto_state_on(self, 'retryAsserted', 'backoff')
 
     def state_backoff(self, S):
         S.validTransitions(['failed', 'connecting', 'closed'])
@@ -331,7 +331,7 @@ class SocketMgrFSM(FSM):
                 self.sm_delay = self.sm_max_delay
 
         S.timeout(delay, lambda: S.gotoState('connecting'))
-        S.on(self, 'closeAsserted', lambda: S.gotoState('closed'))
+        S.goto_state_on(self, 'closeAsserted', 'closed')
 
     def state_closed(self, S):
         S.validTransitions(['backoff', 'connecting'])
@@ -339,8 +339,8 @@ class SocketMgrFSM(FSM):
             self.sm_socket.destroy()
         self.sm_socket = None
         self.sm_log.debug('connection closed')
-        S.on(self, 'retryAsserted', lambda: S.gotoState('backoff'))
-        S.on(self, 'connectAsserted', lambda: S.gotoState('connecting'))
+        S.goto_state_on(self, 'retryAsserted', 'backoff')
+        S.goto_state_on(self, 'connectAsserted', 'connecting')
 
     def state_failed(self, S):
         S.validTransitions([])
@@ -493,7 +493,7 @@ class CueBallClaimHandle(FSM):
 
         self.ch_slot = None
 
-        S.on(self, 'tryAsserted', lambda: S.gotoState('claiming'))
+        S.goto_state_on(self, 'tryAsserted', 'claiming')
 
         def on_timeout():
             self.ch_last_error = mod_errors.ClaimTimeoutError(self.ch_pool)
@@ -511,12 +511,12 @@ class CueBallClaimHandle(FSM):
             S.gotoState('failed')
         S.on(self, 'error', on_error)
 
-        S.on(self, 'cancelled', lambda: S.gotoState('cancelled'))
+        S.goto_state_on(self, 'cancelled', 'cancelled')
 
     def state_claiming(self, S):
         S.validTransitions(['claimed', 'waiting', 'cancelled'])
 
-        S.on(self, 'accepted', lambda: S.gotoState('claimed'))
+        S.goto_state_on(self, 'accepted', 'claimed')
 
         def on_rejected():
             if self.ch_cancelled:
@@ -530,8 +530,8 @@ class CueBallClaimHandle(FSM):
     def state_claimed(self, S):
         S.validTransitions(['released', 'closed'])
 
-        S.on(self, 'releaseAsserted', lambda: S.gotoState('released'))
-        S.on(self, 'closeAsserted', lambda: S.gotoState('closed'))
+        S.goto_state_on(self, 'releaseAsserted', 'released')
+        S.goto_state_on(self, 'closeAsserted', 'closed')
 
         if self.ch_cancelled:
             S.gotoState('released')
@@ -665,7 +665,7 @@ class ConnectionSlotFSM(FSM):
     # -- states ----------------------------------------------------------
 
     def state_init(self, S):
-        S.on(self, 'startAsserted', lambda: S.gotoState('connecting'))
+        S.goto_state_on(self, 'startAsserted', 'connecting')
 
     def state_connecting(self, S):
         S.validTransitions(['failed', 'retrying', 'idle'])
@@ -770,7 +770,7 @@ class ConnectionSlotFSM(FSM):
                     '"%s"' % st)
         S.on(smgr, 'stateChanged', on_changed)
 
-        S.on(self, 'claimAsserted', lambda: S.gotoState('busy'))
+        S.goto_state_on(self, 'claimAsserted', 'busy')
 
         if self.csf_check_timeout is not None and \
                 self.csf_checker is not None:

@@ -182,7 +182,7 @@ class ConnectionSet(FSM):
                 S.gotoState('failed')
         S.on(self, 'closedBackend', on_closed_backend)
 
-        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        S.goto_state_on(self, 'stopAsserted', 'stopping')
 
     def state_failed(self, S):
         S.validTransitions(['running', 'stopping'])
@@ -197,7 +197,7 @@ class ConnectionSet(FSM):
             S.gotoState('running')
         S.on(self, 'connectedToBackend', on_connected)
 
-        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        S.goto_state_on(self, 'stopAsserted', 'stopping')
 
         # Pending-event re-check (same race as the pool's failed state):
         # a connection that reached 'idle'/'busy' in this loop turn
@@ -226,7 +226,7 @@ class ConnectionSet(FSM):
                 S.gotoState('failed')
         S.on(self, 'closedBackend', on_closed_backend)
 
-        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        S.goto_state_on(self, 'stopAsserted', 'stopping')
 
     def state_stopping(self, S):
         S.validTransitions(['stopped'])
@@ -565,7 +565,7 @@ class LogicalConnection(FSM):
         S.on(self.lc_fsm, 'stateChanged', on_fsm_changed)
 
         # Drained before ever advertising: straight to stopped.
-        S.on(self, 'drainAsserted', lambda: S.gotoState('stopped'))
+        S.goto_state_on(self, 'drainAsserted', 'stopped')
 
     def state_advertised(self, S):
         S.validTransitions(['draining', 'stopped'])
@@ -587,7 +587,7 @@ class LogicalConnection(FSM):
                 S.gotoState('draining')
         S.on(self.lc_smgr, 'stateChanged', on_smgr_changed)
 
-        S.on(self, 'drainAsserted', lambda: S.gotoState('draining'))
+        S.goto_state_on(self, 'drainAsserted', 'draining')
 
         self.lc_set.assert_emit(
             'added', self.lc_ckey, self.lc_conn, self.lc_hdl)

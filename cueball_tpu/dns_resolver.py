@@ -238,7 +238,7 @@ class DNSResolverFSM(FSM):
             if self.r_bootstrap.r_ref_count <= 0:
                 self.r_bootstrap.stop()
             self.r_bootstrap = None
-        S.on(self, 'startAsserted', lambda: S.gotoState('check_ns'))
+        S.goto_state_on(self, 'startAsserted', 'check_ns')
 
     def state_check_ns(self, S):
         """Figure out which nameservers to use: explicit IPs, a bootstrap
@@ -676,7 +676,7 @@ class DNSResolverFSM(FSM):
             self.r_log.debug('sleeping %.2fs until next %s expiry',
                              d, state)
             S.timeout(d * 1000, lambda: S.gotoState(state))
-            S.on(self, 'stopAsserted', lambda: S.gotoState('init'))
+            S.goto_state_on(self, 'stopAsserted', 'init')
 
     # -- lookup helper -----------------------------------------------------
 

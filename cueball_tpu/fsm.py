@@ -224,6 +224,11 @@ else:
         timer registrations remain in Python via the mixin."""
         __slots__ = ()
 
+        # The C goto_state_on (closure-free GotoGate) must win over the
+        # mixin's lambda-based version in the MRO.
+        goto_state_on = _native.StateHandleBase.goto_state_on
+        gotoStateOn = goto_state_on
+
 
 def _state_method_name(state: str) -> str:
     return 'state_' + state.replace('.', '_')
@@ -260,8 +265,14 @@ class FSM(EventEmitter):
     def is_in_state(self, state: str) -> bool:
         """True if in `state` or one of its sub-states."""
         cur = self._fsm_state
-        return cur is not None and \
-            (cur == state or cur.startswith(state + '.'))
+        if cur is None:
+            return False
+        if cur == state:
+            return True
+        # Sub-state check without the `state + '.'` concat (this runs
+        # ~14x per claim/release cycle).
+        n = len(state)
+        return len(cur) > n and cur[n] == '.' and cur.startswith(state)
 
     isInState = is_in_state
 

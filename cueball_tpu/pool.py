@@ -416,7 +416,7 @@ class ConnectionPool(FSM):
             self.p_resolver.start()
             self.p_started_resolver = True
 
-        S.on(self, 'connectedToBackend', lambda *a: S.gotoState('running'))
+        S.goto_state_on(self, 'connectedToBackend', 'running')
 
         def on_closed_backend(*a):
             dead = len(self.p_dead)
@@ -428,7 +428,7 @@ class ConnectionPool(FSM):
                 S.gotoState('failed')
         S.on(self, 'closedBackend', on_closed_backend)
 
-        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        S.goto_state_on(self, 'stopAsserted', 'stopping')
 
     def state_failed(self, S):
         S.validTransitions(['running', 'stopping'])
@@ -443,7 +443,7 @@ class ConnectionPool(FSM):
             S.gotoState('running')
         S.on(self, 'connectedToBackend', on_connected)
 
-        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        S.goto_state_on(self, 'stopAsserted', 'stopping')
 
         self._incr_counter('failed-state')
 
@@ -489,7 +489,7 @@ class ConnectionPool(FSM):
                 S.gotoState('failed')
         S.on(self, 'closedBackend', on_closed_backend)
 
-        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        S.goto_state_on(self, 'stopAsserted', 'stopping')
 
     def state_stopping(self, S):
         S.validTransitions(['stopping.backends'])

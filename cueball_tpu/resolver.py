@@ -142,7 +142,7 @@ class ResolverFSM(FSM):
     # -- states ----------------------------------------------------------
 
     def state_stopped(self, S):
-        S.on(self, 'startAsserted', lambda: S.gotoState('starting'))
+        S.goto_state_on(self, 'startAsserted', 'starting')
 
     def state_starting(self, S):
         # Listener registered before start(): the reference relies on
@@ -156,18 +156,18 @@ class ResolverFSM(FSM):
             else:
                 S.gotoState('running')
         S.on(self.r_fsm, 'updated', on_updated)
-        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        S.goto_state_on(self, 'stopAsserted', 'stopping')
         self.r_fsm.start()
 
     def state_running(self, S):
-        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        S.goto_state_on(self, 'stopAsserted', 'stopping')
 
     def state_failed(self, S):
         def on_updated(err=None):
             if not err:
                 S.gotoState('running')
         S.on(self.r_fsm, 'updated', on_updated)
-        S.on(self, 'stopAsserted', lambda: S.gotoState('stopping'))
+        S.goto_state_on(self, 'stopAsserted', 'stopping')
 
     def state_stopping(self, S):
         self.r_fsm.stop()

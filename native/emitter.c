@@ -166,14 +166,62 @@ Gate_init(GateObject *self, PyObject *args, PyObject *kwargs)
     return 0;
 }
 
+/* Borrowed fast read of an FSM bookkeeping field from the instance
+   __dict__ (where FSM.__init__ puts them; no FSM subclass shadows
+   these underscore names with descriptors). Returns a BORROWED ref or
+   NULL; *err set on real failure. Falls back to the generic protocol
+   when the dict or key is absent. */
+static PyObject *
+fsm_field_borrow(PyObject *fsm, PyObject *name, int *err,
+                 PyObject **strong_fallback)
+{
+    *err = 0;
+    *strong_fallback = NULL;
+    PyObject **dp = _PyObject_GetDictPtr(fsm);
+    if (dp != NULL && *dp != NULL) {
+        PyObject *v = PyDict_GetItemWithError(*dp, name);
+        if (v != NULL)
+            return v;
+        if (PyErr_Occurred()) {
+            *err = 1;
+            return NULL;
+        }
+    }
+    PyObject *v = PyObject_GetAttr(fsm, name);
+    if (v == NULL) {
+        *err = 1;
+        return NULL;
+    }
+    *strong_fallback = v;  /* caller must DECREF */
+    return v;
+}
+
+static int
+fsm_field_set(PyObject *fsm, PyObject *name, PyObject *value)
+{
+    PyObject **dp = _PyObject_GetDictPtr(fsm);
+    if (dp != NULL && *dp != NULL)
+        return PyDict_SetItem(*dp, name, value);
+    return PyObject_SetAttr(fsm, name, value);
+}
+
+static int emitter_internal_on_fast(PyObject *emitter);
+static int emitter_on_impl(struct EmitterObject_ *self, PyObject *event,
+                           PyObject *listener);
+static PyObject *fsm_goto_state_impl(PyObject *fsm, PyObject *state);
+static PyObject *fsm_goto_state_thin;  /* defined in the FSM section */
+
 static PyObject *
 Gate_call(GateObject *self, PyObject *args, PyObject *kwargs)
 {
-    PyObject *cur = PyObject_GetAttr(self->fsm, str_fsm_state_handle);
+    int err;
+    PyObject *strong;
+    PyObject *cur = fsm_field_borrow(self->fsm, str_fsm_state_handle,
+                                     &err, &strong);
     if (cur == NULL)
         return NULL;
     int live = (cur == self->handle);
-    Py_DECREF(cur);
+    Py_XDECREF(strong);
     if (!live)
         Py_RETURN_NONE;
     return PyObject_Call(self->cb, args, kwargs);
@@ -283,11 +331,14 @@ SHandle_init(SHandleObject *self, PyObject *args, PyObject *kwargs)
 static int
 shandle_is_current(SHandleObject *self)
 {
-    PyObject *cur = PyObject_GetAttr(self->sh_fsm, str_fsm_state_handle);
+    int err;
+    PyObject *strong;
+    PyObject *cur = fsm_field_borrow(self->sh_fsm, str_fsm_state_handle,
+                                     &err, &strong);
     if (cur == NULL)
         return -1;
     int live = (cur == (PyObject *)self);
-    Py_DECREF(cur);
+    Py_XDECREF(strong);
     return live;
 }
 
@@ -315,15 +366,23 @@ SHandle_on(SHandleObject *self, PyObject *args)
     PyObject *gate = gate_create(self->sh_fsm, (PyObject *)self, cb);
     if (gate == NULL)
         return NULL;
-    /* Method dispatch so emitter-side overrides (e.g. the ClaimHandle
-       misuse trap) see the registration. */
-    PyObject *r = PyObject_CallMethodObjArgs(emitter, str_on, event,
-                                             gate, NULL);
-    if (r == NULL) {
-        Py_DECREF(gate);
-        return NULL;
+    if (emitter_internal_on_fast(emitter)) {
+        if (emitter_on_impl((struct EmitterObject_ *)emitter, event,
+                            gate) < 0) {
+            Py_DECREF(gate);
+            return NULL;
+        }
+    } else {
+        /* Method dispatch so emitter-side overrides that DO constrain
+           internal registrations see this one. */
+        PyObject *r = PyObject_CallMethodObjArgs(emitter, str_on, event,
+                                                 gate, NULL);
+        if (r == NULL) {
+            Py_DECREF(gate);
+            return NULL;
+        }
+        Py_DECREF(r);
     }
-    Py_DECREF(r);
     PyObject *t = PyTuple_Pack(3, emitter, event, gate);
     Py_DECREF(gate);
     if (t == NULL)
@@ -423,12 +482,123 @@ SHandle_goto_state(SHandleObject *self, PyObject *state)
         return NULL;
     }
     self->sh_transitioned = 1;
+    /* Skip the thin Python _goto_state wrapper when the FSM uses the
+       stock one (fsm.py injects it via fsm_configure); dispatch
+       through the method only for an actual override. */
+    if (fsm_goto_state_thin != NULL &&
+        _PyType_Lookup(Py_TYPE(self->sh_fsm), str_goto_state_priv) ==
+            fsm_goto_state_thin)
+        return fsm_goto_state_impl(self->sh_fsm, state);
     PyObject *r = PyObject_CallMethodObjArgs(self->sh_fsm,
                                              str_goto_state_priv, state,
                                              NULL);
     if (r == NULL)
         return NULL;
     Py_DECREF(r);
+    Py_RETURN_NONE;
+}
+
+/* GotoGate: a gated "transition on event" callback with no Python
+   closure — the C equivalent of S.on(emitter, ev, lambda *a:
+   S.gotoState(state)), which the hot FSM states register constantly.
+   Stale-handle semantics match that composition exactly: a no-op when
+   the handle is no longer current (the gate), a RuntimeError when the
+   handle is current but already transitioned (S.gotoState). */
+typedef struct {
+    PyObject_HEAD
+    PyObject *gg_handle;  /* SHandleObject, strong */
+    PyObject *gg_state;
+} GotoGateObject;
+
+static PyTypeObject GotoGate_Type;
+
+static int
+GotoGate_traverse(GotoGateObject *self, visitproc visit, void *arg)
+{
+    Py_VISIT(self->gg_handle);
+    Py_VISIT(self->gg_state);
+    return 0;
+}
+
+static int
+GotoGate_clear(GotoGateObject *self)
+{
+    Py_CLEAR(self->gg_handle);
+    Py_CLEAR(self->gg_state);
+    return 0;
+}
+
+static void
+GotoGate_dealloc(GotoGateObject *self)
+{
+    PyObject_GC_UnTrack(self);
+    GotoGate_clear(self);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *
+GotoGate_call(GotoGateObject *self, PyObject *args, PyObject *kwargs)
+{
+    SHandleObject *sh = (SHandleObject *)self->gg_handle;
+    int live = shandle_is_current(sh);
+    if (live < 0)
+        return NULL;
+    if (!live)
+        Py_RETURN_NONE;
+    return SHandle_goto_state(sh, self->gg_state);
+}
+
+static PyTypeObject GotoGate_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "cueball_tpu._cueball_native.GotoGate",
+    .tp_basicsize = sizeof(GotoGateObject),
+    .tp_dealloc = (destructor)GotoGate_dealloc,
+    .tp_call = (ternaryfunc)GotoGate_call,
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_traverse = (traverseproc)GotoGate_traverse,
+    .tp_clear = (inquiry)GotoGate_clear,
+    .tp_new = PyType_GenericNew,
+};
+
+static PyObject *
+SHandle_goto_state_on(SHandleObject *self, PyObject *args)
+{
+    PyObject *emitter, *event, *state;
+    if (!PyArg_ParseTuple(args, "OOO", &emitter, &event, &state))
+        return NULL;
+    GotoGateObject *g = PyObject_GC_New(GotoGateObject, &GotoGate_Type);
+    if (g == NULL)
+        return NULL;
+    Py_INCREF(self);
+    g->gg_handle = (PyObject *)self;
+    Py_INCREF(state);
+    g->gg_state = state;
+    PyObject_GC_Track((PyObject *)g);
+    if (emitter_internal_on_fast(emitter)) {
+        if (emitter_on_impl((struct EmitterObject_ *)emitter, event,
+                            (PyObject *)g) < 0) {
+            Py_DECREF(g);
+            return NULL;
+        }
+    } else {
+        /* Method dispatch so emitter-side overrides see the
+           registration (same as SHandle_on). */
+        PyObject *r = PyObject_CallMethodObjArgs(emitter, str_on, event,
+                                                 (PyObject *)g, NULL);
+        if (r == NULL) {
+            Py_DECREF(g);
+            return NULL;
+        }
+        Py_DECREF(r);
+    }
+    PyObject *t = PyTuple_Pack(3, emitter, event, (PyObject *)g);
+    Py_DECREF(g);
+    if (t == NULL)
+        return NULL;
+    int rc = PyList_Append(self->sh_disposables, t);
+    Py_DECREF(t);
+    if (rc < 0)
+        return NULL;
     Py_RETURN_NONE;
 }
 
@@ -453,6 +623,11 @@ static PyMethodDef SHandle_methods[] = {
      "Request a transition; raises from a stale handle."},
     {"gotoState", (PyCFunction)SHandle_goto_state, METH_O,
      "Alias of goto_state."},
+    {"goto_state_on", (PyCFunction)SHandle_goto_state_on, METH_VARARGS,
+     "Transition to `state` when `emitter` emits `event` (closure-free"
+     " C fast path of S.on(emitter, event, lambda: S.gotoState(...)))."},
+    {"gotoStateOn", (PyCFunction)SHandle_goto_state_on, METH_VARARGS,
+     "Alias of goto_state_on."},
     {NULL}
 };
 
@@ -486,7 +661,7 @@ static PyTypeObject SHandle_Type = {
 /* ------------------------------------------------------------------ */
 /* EventEmitter                                                        */
 
-typedef struct {
+typedef struct EmitterObject_ {
     PyObject_HEAD
     PyObject *ee_listeners;  /* dict: str -> list */
     PyObject *inst_dict;     /* instance __dict__ (tp_dictoffset) */
@@ -540,26 +715,32 @@ Emitter_init(EmitterObject *self, PyObject *args, PyObject *kwargs)
     return 0;
 }
 
+static int
+emitter_on_impl(EmitterObject *self, PyObject *event, PyObject *listener)
+{
+    PyObject *lst = PyDict_GetItemWithError(self->ee_listeners, event);
+    if (lst == NULL) {
+        if (PyErr_Occurred())
+            return -1;
+        lst = PyList_New(0);
+        if (lst == NULL)
+            return -1;
+        if (PyDict_SetItem(self->ee_listeners, event, lst) < 0) {
+            Py_DECREF(lst);
+            return -1;
+        }
+        Py_DECREF(lst);  /* dict holds it */
+    }
+    return PyList_Append(lst, listener);
+}
+
 static PyObject *
 Emitter_on(EmitterObject *self, PyObject *args)
 {
     PyObject *event, *listener;
     if (!PyArg_ParseTuple(args, "OO", &event, &listener))
         return NULL;
-    PyObject *lst = PyDict_GetItemWithError(self->ee_listeners, event);
-    if (lst == NULL) {
-        if (PyErr_Occurred())
-            return NULL;
-        lst = PyList_New(0);
-        if (lst == NULL)
-            return NULL;
-        if (PyDict_SetItem(self->ee_listeners, event, lst) < 0) {
-            Py_DECREF(lst);
-            return NULL;
-        }
-        Py_DECREF(lst);  /* dict holds it */
-    }
-    if (PyList_Append(lst, listener) < 0)
+    if (emitter_on_impl(self, event, listener) < 0)
         return NULL;
     Py_INCREF(listener);
     return listener;
@@ -704,9 +885,18 @@ Emitter_listener_count(EmitterObject *self, PyObject *args)
 static PyObject *
 getattr_or_null(PyObject *o, PyObject *name)
 {
-    PyObject *v = PyObject_GetAttr(o, name);
-    if (v == NULL && PyErr_ExceptionMatches(PyExc_AttributeError))
-        PyErr_Clear();
+    /* Suppressed-AttributeError lookup: no exception is materialized
+       for a plain miss — count_external runs this for every listener
+       on every leak-check, so the exception churn is measurable on the
+       claim hot path. Any non-AttributeError raised by a property
+       stays set (Python getattr semantics). */
+    PyObject *v;
+#if PY_VERSION_HEX >= 0x030d0000
+    (void)PyObject_GetOptionalAttr(o, name, &v);
+#else
+    (void)_PyObject_LookupAttr(o, name, &v);  /* public in 3.13 as
+                                                 PyObject_GetOptionalAttr */
+#endif
     return v;
 }
 
@@ -751,7 +941,7 @@ Emitter_count_external(EmitterObject *self, PyObject *args)
             Py_DECREF(lst);
             return NULL;
         }
-        if (Py_TYPE(h) == &Gate_Type)
+        if (Py_TYPE(h) == &Gate_Type || Py_TYPE(h) == &GotoGate_Type)
             continue;
         PyObject *w = getattr_or_null(h, str_wrapped_listener);
         if (w == NULL && PyErr_Occurred()) {
@@ -786,6 +976,46 @@ Emitter_count_external(EmitterObject *self, PyObject *args)
     }
     Py_DECREF(lst);
     return PyLong_FromLong(count);
+}
+
+static PyObject *
+Emitter_is_in_state(EmitterObject *self, PyObject *state)
+{
+    /* FSM sub-state-aware current-state test ("a.b" is in "a"); reads
+       the _fsm_state field FSM.__init__ places in the instance
+       __dict__. Lives on the emitter base type so FSM instances get a
+       frameless C call — it is the single most-called predicate on the
+       claim path. Non-FSM emitters raise AttributeError (_fsm_state),
+       morally the same as the method not existing. */
+    int err;
+    PyObject *strong;
+    PyObject *cur = fsm_field_borrow((PyObject *)self, str_fsm_state,
+                                     &err, &strong);
+    if (cur == NULL)
+        return NULL;
+    int res = 0;
+    if (cur != Py_None) {
+        if (PyUnicode_Check(cur) && PyUnicode_Check(state)) {
+            if (PyUnicode_Compare(cur, state) == 0) {
+                res = 1;
+            } else {
+                Py_ssize_t ls = PyUnicode_GET_LENGTH(state);
+                Py_ssize_t lc = PyUnicode_GET_LENGTH(cur);
+                if (lc > ls && PyUnicode_ReadChar(cur, ls) == '.' &&
+                    PyUnicode_Tailmatch(cur, state, 0, ls, -1) == 1)
+                    res = 1;
+            }
+        } else {
+            int eq = PyObject_RichCompareBool(cur, state, Py_EQ);
+            if (eq < 0) {
+                Py_XDECREF(strong);
+                return NULL;
+            }
+            res = eq;
+        }
+    }
+    Py_XDECREF(strong);
+    return PyBool_FromLong(res);
 }
 
 static PyObject *
@@ -967,6 +1197,145 @@ static PyObject *str_state_changed;    /* "stateChanged" */
 static PyObject *str_state_prefix;     /* "state_" */
 static PyObject *str_dot;              /* "." */
 static PyObject *str_underscore;       /* "_" */
+static PyObject *str_call_exc_handler; /* "call_exception_handler" */
+static PyObject *str_message;          /* "message" */
+static PyObject *str_exception;        /* "exception" */
+static PyObject *str_safe_internal_on; /* "_cueball_safe_internal_on" */
+static PyObject *str_valid_priv;       /* "_valid" */
+static PyObject *str_in_transition;    /* "_fsm_in_transition" */
+static PyObject *str_fsm_pending;      /* "_fsm_pending" */
+static PyObject *emitter_on_descr;     /* base EventEmitter.on descr */
+static PyObject *fsm_goto_state_thin;  /* fsm.py's native _goto_state fn */
+
+/* True when framework-internal registrations may append straight to
+   the C listener table: the emitter is a native EventEmitter whose
+   `on` is either un-overridden, or whose class explicitly declares
+   its override irrelevant to internal events via
+   `_cueball_safe_internal_on = True` (e.g. the ClaimHandle misuse
+   trap, which only rejects user 'readable'/'close' subscriptions). */
+static int
+emitter_internal_on_fast(PyObject *emitter)
+{
+    if (!PyObject_TypeCheck(emitter, &Emitter_Type))
+        return 0;
+    PyObject *on_attr = _PyType_Lookup(Py_TYPE(emitter), str_on);
+    if (on_attr == emitter_on_descr)
+        return 1;
+    return _PyType_Lookup(Py_TYPE(emitter), str_safe_internal_on) ==
+        Py_True;
+}
+
+/* Coalesced deferred stateChanged emission.
+
+   The reference emits stateChanged via setImmediate (mooremachine);
+   the Python engine mirrors that with one loop.call_soon per
+   transition. On the claim hot path that is ~6 call_soon round-trips
+   through asyncio's Python scheduling machinery per claim/release
+   cycle. Instead, C batches the (fsm, state) pairs of a synchronous
+   burst and schedules ONE call_soon that drains the batch FIFO.
+
+   Iteration-boundary semantics are preserved exactly: the drain only
+   delivers the entries present when it starts; emissions queued
+   *during* the drain go to a fresh batch drained by a new call_soon on
+   the next loop iteration — which is also how node's setImmediate
+   treats immediates queued from an immediate. Per-emission exceptions
+   are routed to loop.call_exception_handler({'message', 'exception'})
+   and do not stop the rest of the batch, matching how an exception in
+   an individual call_soon callback behaves. */
+static PyObject *drain_loop;      /* loop owning the pending batch */
+static PyObject *drain_pending;   /* flat list [fsm1, state1, ...] */
+static int drain_scheduled;
+static PyObject *drain_callable;  /* the module-level drain fn */
+
+static PyObject *
+fsm_drain_state_changed(PyObject *mod, PyObject *noargs)
+{
+    (void)mod; (void)noargs;
+    if (drain_pending == NULL)
+        Py_RETURN_NONE;
+    PyObject *batch = drain_pending;
+    drain_pending = NULL;           /* appends now open a fresh batch */
+    drain_scheduled = 0;
+    PyObject *loop = drain_loop;
+    Py_XINCREF(loop);
+
+    Py_ssize_t n = PyList_GET_SIZE(batch);
+    for (Py_ssize_t i = 0; i + 1 < n; i += 2) {
+        PyObject *fsm = PyList_GET_ITEM(batch, i);
+        PyObject *state = PyList_GET_ITEM(batch, i + 1);
+        PyObject *r = PyObject_CallMethodObjArgs(
+            fsm, str_emit, str_state_changed, state, NULL);
+        if (r != NULL) {
+            Py_DECREF(r);
+            continue;
+        }
+        /* Route to the loop's exception handler (what asyncio does
+           for a failing call_soon callback) and keep draining. */
+        PyObject *exc = PyErr_GetRaisedException();
+        if (exc == NULL)
+            continue;
+        int handled = 0;
+        if (loop != NULL) {
+            PyObject *ctx = PyDict_New();
+            if (ctx != NULL &&
+                PyDict_SetItem(ctx, str_message,
+                               str_state_changed) == 0 &&
+                PyDict_SetItem(ctx, str_exception, exc) == 0) {
+                PyObject *hr = PyObject_CallMethodObjArgs(
+                    loop, str_call_exc_handler, ctx, NULL);
+                if (hr != NULL) {
+                    Py_DECREF(hr);
+                    handled = 1;
+                } else {
+                    PyErr_Clear();
+                }
+            } else {
+                PyErr_Clear();
+            }
+            Py_XDECREF(ctx);
+        }
+        if (!handled) {
+            PyErr_SetRaisedException(Py_NewRef(exc));
+            PyErr_WriteUnraisable(fsm);
+        }
+        Py_DECREF(exc);
+    }
+    Py_DECREF(batch);
+    Py_XDECREF(loop);
+    Py_RETURN_NONE;
+}
+
+/* Queue one deferred stateChanged emission on `loop`. Returns 0/-1. */
+static int
+fsm_schedule_state_changed(PyObject *loop, PyObject *fsm, PyObject *state)
+{
+    if (drain_loop != loop) {
+        /* New/different loop: any stale batch belonged to a loop that
+           will never run its drain callback (same fate as individual
+           call_soon handles on a dead loop). */
+        Py_CLEAR(drain_pending);
+        Py_INCREF(loop);
+        Py_XSETREF(drain_loop, loop);
+        drain_scheduled = 0;
+    }
+    if (drain_pending == NULL) {
+        drain_pending = PyList_New(0);
+        if (drain_pending == NULL)
+            return -1;
+    }
+    if (PyList_Append(drain_pending, fsm) < 0 ||
+        PyList_Append(drain_pending, state) < 0)
+        return -1;
+    if (!drain_scheduled) {
+        PyObject *r = PyObject_CallMethodObjArgs(
+            loop, str_call_soon, drain_callable, NULL);
+        if (r == NULL)
+            return -1;
+        Py_DECREF(r);
+        drain_scheduled = 1;
+    }
+    return 0;
+}
 
 static PyObject *
 fsm_configure(PyObject *mod, PyObject *args)
@@ -1072,26 +1441,30 @@ fsm_lookup_entry(PyObject *fsm, PyObject *state)
 }
 
 static PyObject *
-fsm_run_transition(PyObject *mod, PyObject *args)
+fsm_run_transition_impl(PyObject *fsm, PyObject *state)
 {
-    PyObject *fsm, *state;
-    if (!PyArg_ParseTuple(args, "OO", &fsm, &state))
-        return NULL;
     if (fsm_handle_class == NULL) {
         PyErr_SetString(PyExc_RuntimeError,
                         "fsm_configure() has not been called");
         return NULL;
     }
 
-    PyObject *old = PyObject_GetAttr(fsm, str_fsm_state);
-    if (old == NULL)
+    int err;
+    PyObject *strong;
+    PyObject *old_b = fsm_field_borrow(fsm, str_fsm_state, &err, &strong);
+    if (old_b == NULL)
         return NULL;
+    PyObject *old = Py_NewRef(old_b);
+    Py_XDECREF(strong);
 
-    PyObject *cur_handle = PyObject_GetAttr(fsm, str_fsm_state_handle);
-    if (cur_handle == NULL) {
+    PyObject *cur_b = fsm_field_borrow(fsm, str_fsm_state_handle,
+                                       &err, &strong);
+    if (cur_b == NULL) {
         Py_DECREF(old);
         return NULL;
     }
+    PyObject *cur_handle = Py_NewRef(cur_b);
+    Py_XDECREF(strong);
     if (cur_handle != Py_None) {
         PyObject *r;
         if (Py_TYPE(cur_handle) == &SHandle_Type ||
@@ -1107,7 +1480,7 @@ fsm_run_transition(PyObject *mod, PyObject *args)
             return NULL;
         }
         Py_DECREF(r);
-        if (PyObject_SetAttr(fsm, str_fsm_state_handle, Py_None) < 0) {
+        if (fsm_field_set(fsm, str_fsm_state_handle, Py_None) < 0) {
             Py_DECREF(cur_handle);
             Py_DECREF(old);
             return NULL;
@@ -1121,12 +1494,17 @@ fsm_run_transition(PyObject *mod, PyObject *args)
         return NULL;
     }
 
-    if (PyObject_SetAttr(fsm, str_fsm_state, state) < 0)
+    if (fsm_field_set(fsm, str_fsm_state, state) < 0)
         goto fail;
 
     /* History ring buffer. */
     {
-        PyObject *hist = PyObject_GetAttr(fsm, str_fsm_history);
+        int herr;
+        PyObject *hstrong;
+        PyObject *hist_b = fsm_field_borrow(fsm, str_fsm_history,
+                                            &herr, &hstrong);
+        PyObject *hist = hist_b ? Py_NewRef(hist_b) : NULL;
+        Py_XDECREF(hstrong);
         if (hist == NULL || !PyList_Check(hist)) {
             Py_XDECREF(hist);
             if (!PyErr_Occurred())
@@ -1165,7 +1543,7 @@ fsm_run_transition(PyObject *mod, PyObject *args)
             fsm_handle_class, fsm, state, NULL);
         if (handle == NULL)
             goto fail;
-        if (PyObject_SetAttr(fsm, str_fsm_state_handle, handle) < 0) {
+        if (fsm_field_set(fsm, str_fsm_state_handle, handle) < 0) {
             Py_DECREF(handle);
             goto fail;
         }
@@ -1213,19 +1591,10 @@ fsm_run_transition(PyObject *mod, PyObject *args)
                 goto fail;
             Py_DECREF(r);
         } else {
-            PyObject *emit = PyObject_GetAttr(fsm, str_emit);
-            if (emit == NULL) {
-                Py_DECREF(loop);
-                goto fail;
-            }
-            PyObject *r = PyObject_CallMethodObjArgs(
-                loop, str_call_soon, emit, str_state_changed, state,
-                NULL);
-            Py_DECREF(emit);
+            int rc = fsm_schedule_state_changed(loop, fsm, state);
             Py_DECREF(loop);
-            if (r == NULL)
+            if (rc < 0)
                 goto fail;
-            Py_DECREF(r);
         }
     }
 
@@ -1239,6 +1608,164 @@ fail:
     return NULL;
 }
 
+static PyObject *
+fsm_run_transition(PyObject *mod, PyObject *args)
+{
+    PyObject *fsm, *state;
+    if (!PyArg_ParseTuple(args, "OO", &fsm, &state))
+        return NULL;
+    return fsm_run_transition_impl(fsm, state);
+}
+
+/* C port of FSM._check_transition: validate `state` against the
+   current handle's validTransitions whitelist. */
+static int
+fsm_check_transition(PyObject *fsm, PyObject *state)
+{
+    int err;
+    PyObject *hstrong;
+    PyObject *h = fsm_field_borrow(fsm, str_fsm_state_handle, &err,
+                                   &hstrong);
+    if (h == NULL)
+        return -1;
+    int rc = 0;
+    if (h != Py_None) {
+        PyObject *valid;
+        int vstrong = 0;
+        if (PyObject_TypeCheck(h, &SHandle_Type)) {
+            valid = ((SHandleObject *)h)->sh_valid;
+        } else {
+            valid = PyObject_GetAttr(h, str_valid_priv);
+            if (valid == NULL) {
+                Py_XDECREF(hstrong);
+                return -1;
+            }
+            vstrong = 1;
+        }
+        if (valid != NULL && valid != Py_None) {
+            int found = PySequence_Contains(valid, state);
+            if (found < 0) {
+                rc = -1;
+            } else if (!found) {
+                int e2;
+                PyObject *s2 = NULL;
+                PyObject *cur = fsm_field_borrow(fsm, str_fsm_state,
+                                                 &e2, &s2);
+                PyErr_Format(PyExc_RuntimeError,
+                             "%R: invalid transition \"%S\" -> \"%S\" "
+                             "(valid: %R)", fsm,
+                             cur ? cur : Py_None, state, valid);
+                Py_XDECREF(s2);
+                rc = -1;
+            }
+        }
+        if (vstrong)
+            Py_DECREF(valid);
+    }
+    Py_XDECREF(hstrong);
+    return rc;
+}
+
+/* C port of FSM._goto_state: whitelist check, re-entrant transition
+   serialization via _fsm_pending, and the finally-semantics of the
+   Python engine (in-transition flag cleared and stale pending hops
+   dropped even on a failed transition). */
+static PyObject *
+fsm_goto_state_impl(PyObject *fsm, PyObject *state)
+{
+    if (fsm_check_transition(fsm, state) < 0)
+        return NULL;
+
+    int err;
+    PyObject *strong;
+    PyObject *flag = fsm_field_borrow(fsm, str_in_transition, &err,
+                                      &strong);
+    if (flag == NULL)
+        return NULL;
+    int in_trans = PyObject_IsTrue(flag);
+    Py_XDECREF(strong);
+    if (in_trans < 0)
+        return NULL;
+
+    PyObject *pending_b = fsm_field_borrow(fsm, str_fsm_pending, &err,
+                                           &strong);
+    if (pending_b == NULL)
+        return NULL;
+    PyObject *pending = Py_NewRef(pending_b);
+    Py_XDECREF(strong);
+    if (!PyList_Check(pending)) {
+        Py_DECREF(pending);
+        PyErr_SetString(PyExc_TypeError, "_fsm_pending must be a list");
+        return NULL;
+    }
+
+    if (in_trans) {
+        int rc = PyList_Append(pending, state);
+        Py_DECREF(pending);
+        if (rc < 0)
+            return NULL;
+        Py_RETURN_NONE;
+    }
+
+    if (fsm_field_set(fsm, str_in_transition, Py_True) < 0) {
+        Py_DECREF(pending);
+        return NULL;
+    }
+    PyObject *r = fsm_run_transition_impl(fsm, state);
+    int ok = (r != NULL);
+    Py_XDECREF(r);
+    while (ok && PyList_GET_SIZE(pending) > 0) {
+        PyObject *nxt = Py_NewRef(PyList_GET_ITEM(pending, 0));
+        if (PyList_SetSlice(pending, 0, 1, NULL) < 0 ||
+            fsm_check_transition(fsm, nxt) < 0) {
+            Py_DECREF(nxt);
+            ok = 0;
+            break;
+        }
+        r = fsm_run_transition_impl(fsm, nxt);
+        Py_DECREF(nxt);
+        if (r == NULL) {
+            ok = 0;
+            break;
+        }
+        Py_DECREF(r);
+    }
+
+    /* finally: clear the flag and any stale queued hops, preserving
+       the original exception over cleanup failures. */
+    PyObject *exc = ok ? NULL : PyErr_GetRaisedException();
+    if (fsm_field_set(fsm, str_in_transition, Py_False) < 0 && ok) {
+        exc = PyErr_GetRaisedException();
+        ok = 0;
+    }
+    PyErr_Clear();
+    if (PyList_SetSlice(pending, 0, PyList_GET_SIZE(pending),
+                        NULL) < 0 && ok) {
+        exc = PyErr_GetRaisedException();
+        ok = 0;
+    }
+    PyErr_Clear();
+    Py_DECREF(pending);
+    if (!ok) {
+        if (exc != NULL)
+            PyErr_SetRaisedException(exc);
+        else
+            PyErr_SetString(PyExc_RuntimeError,
+                            "FSM transition failed");
+        return NULL;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+fsm_goto_state(PyObject *mod, PyObject *args)
+{
+    PyObject *fsm, *state;
+    if (!PyArg_ParseTuple(args, "OO", &fsm, &state))
+        return NULL;
+    return fsm_goto_state_impl(fsm, state);
+}
+
 /* ------------------------------------------------------------------ */
 /* module                                                              */
 
@@ -1247,6 +1774,11 @@ static PyMethodDef native_methods[] = {
      "Inject (StateHandle class, tracer list, get_running_loop)."},
     {"fsm_run_transition", (PyCFunction)fsm_run_transition, METH_VARARGS,
      "Run one FSM state transition (C port of FSM._run_transition)."},
+    {"fsm_drain_state_changed", (PyCFunction)fsm_drain_state_changed,
+     METH_NOARGS,
+     "Deliver the pending batch of deferred stateChanged emissions."},
+    {"fsm_goto_state", (PyCFunction)fsm_goto_state, METH_VARARGS,
+     "Request an FSM transition (C port of FSM._goto_state)."},
     {NULL}
 };
 
@@ -1297,18 +1829,39 @@ PyInit__cueball_native(void)
         (str_state_prefix =
             PyUnicode_InternFromString("state_")) == NULL ||
         (str_dot = PyUnicode_InternFromString(".")) == NULL ||
-        (str_underscore = PyUnicode_InternFromString("_")) == NULL)
+        (str_underscore = PyUnicode_InternFromString("_")) == NULL ||
+        (str_call_exc_handler =
+            PyUnicode_InternFromString("call_exception_handler")) == NULL ||
+        (str_message = PyUnicode_InternFromString("message")) == NULL ||
+        (str_exception =
+            PyUnicode_InternFromString("exception")) == NULL)
         return NULL;
 
     if (PyType_Ready(&Emitter_Type) < 0 ||
         PyType_Ready(&Once_Type) < 0 ||
         PyType_Ready(&Gate_Type) < 0 ||
+        PyType_Ready(&GotoGate_Type) < 0 ||
         PyType_Ready(&SHandle_Type) < 0)
         return NULL;
+
+    /* GotoGates are framework-internal listeners: make the marker
+       visible to the Python-side count_listeners fallback too (the C
+       count_external recognizes the type directly). */
+    if (PyDict_SetItemString(GotoGate_Type.tp_dict, "_cueball_internal",
+                             Py_True) < 0)
+        return NULL;
+    PyType_Modified(&GotoGate_Type);
 
     PyObject *m = PyModule_Create(&native_module);
     if (m == NULL)
         return NULL;
+
+    /* The drain callback handed to loop.call_soon. */
+    drain_callable = PyObject_GetAttrString(m, "fsm_drain_state_changed");
+    if (drain_callable == NULL) {
+        Py_DECREF(m);
+        return NULL;
+    }
 
     Py_INCREF(&Emitter_Type);
     if (PyModule_AddObject(m, "EventEmitter",
