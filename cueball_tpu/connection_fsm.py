@@ -357,6 +357,12 @@ class CueBallClaimHandle(FSM):
     """FSM handed out to pool users on claim()
     (reference lib/connection-fsm.js:427-784)."""
 
+    # The on/once overrides below only reject *user* 'readable'/'close'
+    # subscriptions; framework-internal state registrations never use
+    # those events, so the native core may append them straight to the
+    # C listener table (emitter.c emitter_internal_on_fast).
+    _cueball_safe_internal_on = True
+
     def __init__(self, options: dict):
         claim_timeout = options['claimTimeout']
         self.ch_claim_timeout = claim_timeout

@@ -312,7 +312,7 @@ class FSM(EventEmitter):
                     '%r: invalid transition "%s" -> "%s" (valid: %r)' % (
                         self, self._fsm_state, state, handle._valid))
 
-    def _goto_state(self, state: str) -> None:
+    def _py_goto_state(self, state: str) -> None:
         self._check_transition(state)
 
         # Re-entrant gotoState (a state entry function that transitions
@@ -384,8 +384,19 @@ class FSM(EventEmitter):
             self.emit('stateChanged', state)
 
     if _native is None:
+        _goto_state = _py_goto_state
         _run_transition = _py_run_transition
     else:
+        def _goto_state(self, state: str) -> None:
+            # C port of _py_goto_state (native/emitter.c
+            # fsm_goto_state): whitelist check, re-entrant transition
+            # serialization, and finally-cleanup all run in C. The
+            # Python body above remains the reference semantics and
+            # the CUEBALL_NO_NATIVE fallback. fsm_configure() hands
+            # this exact function to C so StateHandle.goto_state can
+            # skip the wrapper when it is not overridden.
+            _native.fsm_goto_state(self, state)
+
         def _run_transition(self, state: str) -> None:
             # C port of _py_run_transition (native/emitter.c
             # fsm_run_transition); the Python body above remains the
@@ -397,8 +408,17 @@ class FSM(EventEmitter):
 
 
 if _native is not None:
+    # The C is_in_state (emitter.c Emitter_is_in_state) is a frameless
+    # C call for the single most-called predicate on the claim path;
+    # semantics match the Python body above exactly.
+    FSM.is_in_state = _native.EventEmitter.is_in_state
+    FSM.isInState = _native.EventEmitter.is_in_state
     # Inject the Python-side pieces the C transition engine needs: the
     # concrete StateHandle class, the (shared, mutable) tracer list,
-    # and asyncio's running-loop accessor.
+    # asyncio's running-loop accessor, and the stock transition
+    # functions (so the C engine runs its inlined ports only for
+    # classes that do NOT override them — a subclass _goto_state,
+    # _check_transition, or _run_transition is always dispatched).
     _native.fsm_configure(StateHandle, _TRANSITION_TRACERS,
-                          asyncio.get_running_loop)
+                          asyncio.get_running_loop, FSM._goto_state,
+                          FSM._check_transition, FSM._run_transition)

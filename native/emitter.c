@@ -205,11 +205,12 @@ fsm_field_set(PyObject *fsm, PyObject *name, PyObject *value)
     return PyObject_SetAttr(fsm, name, value);
 }
 
+struct EmitterObject_;  /* file-scope tag; defined in the emitter section */
 static int emitter_internal_on_fast(PyObject *emitter);
 static int emitter_on_impl(struct EmitterObject_ *self, PyObject *event,
                            PyObject *listener);
 static PyObject *fsm_goto_state_impl(PyObject *fsm, PyObject *state);
-static PyObject *fsm_goto_state_thin;  /* defined in the FSM section */
+static PyObject *fsm_goto_state_thin;  /* set by fsm_configure */
 
 static PyObject *
 Gate_call(GateObject *self, PyObject *args, PyObject *kwargs)
@@ -1143,6 +1144,9 @@ static PyMethodDef Emitter_methods[] = {
      "Number of listeners for event."},
     {"count_external", (PyCFunction)Emitter_count_external, METH_VARARGS,
      "Number of non-framework listeners for event."},
+    {"is_in_state", (PyCFunction)Emitter_is_in_state, METH_O,
+     "FSM current-state test, sub-state aware (\"a.b\" is in \"a\"); "
+     "fsm.py rebinds this onto FSM when the native core is active."},
     {"event_names", (PyCFunction)Emitter_event_names, METH_NOARGS,
      "Events with at least one listener."},
     {"emit", (PyCFunction)Emitter_emit, METH_VARARGS,
@@ -1204,8 +1208,12 @@ static PyObject *str_safe_internal_on; /* "_cueball_safe_internal_on" */
 static PyObject *str_valid_priv;       /* "_valid" */
 static PyObject *str_in_transition;    /* "_fsm_in_transition" */
 static PyObject *str_fsm_pending;      /* "_fsm_pending" */
+static PyObject *str_is_closed;        /* "is_closed" */
+static PyObject *str_check_transition; /* "_check_transition" */
+static PyObject *str_run_transition;   /* "_run_transition" */
 static PyObject *emitter_on_descr;     /* base EventEmitter.on descr */
-static PyObject *fsm_goto_state_thin;  /* fsm.py's native _goto_state fn */
+static PyObject *fsm_check_thin;       /* stock FSM._check_transition */
+static PyObject *fsm_run_thin;         /* stock FSM._run_transition */
 
 /* True when framework-internal registrations may append straight to
    the C listener table: the emitter is a native EventEmitter whose
@@ -1241,23 +1249,39 @@ emitter_internal_on_fast(PyObject *emitter)
    treats immediates queued from an immediate. Per-emission exceptions
    are routed to loop.call_exception_handler({'message', 'exception'})
    and do not stop the rest of the batch, matching how an exception in
-   an individual call_soon callback behaves. */
-static PyObject *drain_loop;      /* loop owning the pending batch */
-static PyObject *drain_pending;   /* flat list [fsm1, state1, ...] */
-static int drain_scheduled;
+   an individual call_soon callback behaves.
+
+   Batches are tracked PER LOOP (dict loop -> flat [fsm1, state1, ...]):
+   FSMs living on different event loops (multi-threaded asyncio, or a
+   second loop in-process) each get their own batch and their own
+   call_soon, so one loop scheduling can never drop another live loop's
+   still-pending emissions. An entry's presence in the dict means its
+   drain callback is scheduled. Batches stranded on loops that closed
+   before draining are pruned lazily at the next schedule. */
+static PyObject *drain_map;       /* dict: loop -> flat pending list */
 static PyObject *drain_callable;  /* the module-level drain fn */
 
 static PyObject *
-fsm_drain_state_changed(PyObject *mod, PyObject *noargs)
+fsm_drain_state_changed(PyObject *mod, PyObject *loop)
 {
-    (void)mod; (void)noargs;
-    if (drain_pending == NULL)
+    (void)mod;
+    if (drain_map == NULL)
         Py_RETURN_NONE;
-    PyObject *batch = drain_pending;
-    drain_pending = NULL;           /* appends now open a fresh batch */
-    drain_scheduled = 0;
-    PyObject *loop = drain_loop;
-    Py_XINCREF(loop);
+    PyObject *batch = PyDict_GetItemWithError(drain_map, loop);
+    if (batch == NULL) {
+        if (PyErr_Occurred())
+            return NULL;
+        Py_RETURN_NONE;
+    }
+    /* Detach before delivering: emissions queued during the drain open
+       a fresh batch (and a fresh call_soon), preserving the
+       iteration-boundary semantics of setImmediate. */
+    Py_INCREF(batch);
+    if (PyDict_DelItem(drain_map, loop) < 0) {
+        Py_DECREF(batch);
+        return NULL;
+    }
+    Py_INCREF(loop);
 
     Py_ssize_t n = PyList_GET_SIZE(batch);
     for (Py_ssize_t i = 0; i + 1 < n; i += 2) {
@@ -1305,43 +1329,100 @@ fsm_drain_state_changed(PyObject *mod, PyObject *noargs)
     Py_RETURN_NONE;
 }
 
+/* Drop batches whose loop closed before its drain callback ran (their
+   emissions died with the loop, exactly like individual call_soon
+   handles on a closed loop); without this, entries accumulate across
+   asyncio.run() calls. Best-effort: never raises. */
+static void
+drain_prune_closed(void)
+{
+    PyObject *keys = PyDict_Keys(drain_map);
+    if (keys == NULL) {
+        PyErr_Clear();
+        return;
+    }
+    for (Py_ssize_t i = 0; i < PyList_GET_SIZE(keys); i++) {
+        PyObject *k = PyList_GET_ITEM(keys, i);
+        PyObject *c = PyObject_CallMethodObjArgs(k, str_is_closed, NULL);
+        if (c == NULL) {
+            PyErr_Clear();
+            continue;
+        }
+        int closed = PyObject_IsTrue(c);
+        Py_DECREF(c);
+        if (closed > 0) {
+            if (PyDict_DelItem(drain_map, k) < 0)
+                PyErr_Clear();
+        } else if (closed < 0) {
+            PyErr_Clear();
+        }
+    }
+    Py_DECREF(keys);
+}
+
 /* Queue one deferred stateChanged emission on `loop`. Returns 0/-1. */
 static int
 fsm_schedule_state_changed(PyObject *loop, PyObject *fsm, PyObject *state)
 {
-    if (drain_loop != loop) {
-        /* New/different loop: any stale batch belonged to a loop that
-           will never run its drain callback (same fate as individual
-           call_soon handles on a dead loop). */
-        Py_CLEAR(drain_pending);
-        Py_INCREF(loop);
-        Py_XSETREF(drain_loop, loop);
-        drain_scheduled = 0;
-    }
-    if (drain_pending == NULL) {
-        drain_pending = PyList_New(0);
-        if (drain_pending == NULL)
+    if (drain_map == NULL) {
+        drain_map = PyDict_New();
+        if (drain_map == NULL)
             return -1;
     }
-    if (PyList_Append(drain_pending, fsm) < 0 ||
-        PyList_Append(drain_pending, state) < 0)
+    PyObject *batch = PyDict_GetItemWithError(drain_map, loop);
+    if (batch != NULL) {
+        /* Existing batch: its drain is already scheduled. */
+        if (PyList_Append(batch, fsm) < 0)
+            return -1;
+        if (PyList_Append(batch, state) < 0) {
+            /* Keep the installed batch even-length: a dangling fsm
+               would misalign every later (fsm, state) pair the drain
+               delivers. */
+            Py_ssize_t bn = PyList_GET_SIZE(batch);
+            PyObject *exc = PyErr_GetRaisedException();
+            if (PyList_SetSlice(batch, bn - 1, bn, NULL) < 0)
+                PyErr_Clear();
+            PyErr_SetRaisedException(exc);
+            return -1;
+        }
+        return 0;
+    }
+    if (PyErr_Occurred())
         return -1;
-    if (!drain_scheduled) {
-        PyObject *r = PyObject_CallMethodObjArgs(
-            loop, str_call_soon, drain_callable, NULL);
-        if (r == NULL)
-            return -1;
-        Py_DECREF(r);
-        drain_scheduled = 1;
+    if (PyDict_GET_SIZE(drain_map) > 0)
+        drain_prune_closed();
+    batch = PyList_New(0);
+    if (batch == NULL)
+        return -1;
+    if (PyList_Append(batch, fsm) < 0 ||
+        PyList_Append(batch, state) < 0 ||
+        PyDict_SetItem(drain_map, loop, batch) < 0) {
+        Py_DECREF(batch);
+        return -1;
     }
+    Py_DECREF(batch);  /* dict holds it */
+    PyObject *r = PyObject_CallMethodObjArgs(
+        loop, str_call_soon, drain_callable, loop, NULL);
+    if (r == NULL) {
+        /* No drain will run; drop the dead entry so a later schedule
+           on this loop starts clean (preserving call_soon's error). */
+        PyObject *exc = PyErr_GetRaisedException();
+        if (PyDict_DelItem(drain_map, loop) < 0)
+            PyErr_Clear();
+        PyErr_SetRaisedException(exc);
+        return -1;
+    }
+    Py_DECREF(r);
     return 0;
 }
 
 static PyObject *
 fsm_configure(PyObject *mod, PyObject *args)
 {
-    PyObject *handle_cls, *tracers, *get_loop;
-    if (!PyArg_ParseTuple(args, "OOO", &handle_cls, &tracers, &get_loop))
+    PyObject *handle_cls, *tracers, *get_loop, *goto_thin = NULL;
+    PyObject *check_thin = NULL, *run_thin = NULL;
+    if (!PyArg_ParseTuple(args, "OOO|OOO", &handle_cls, &tracers,
+                          &get_loop, &goto_thin, &check_thin, &run_thin))
         return NULL;
     Py_INCREF(handle_cls);
     Py_XSETREF(fsm_handle_class, handle_cls);
@@ -1349,7 +1430,33 @@ fsm_configure(PyObject *mod, PyObject *args)
     Py_XSETREF(fsm_tracers, tracers);
     Py_INCREF(get_loop);
     Py_XSETREF(fsm_get_running_loop, get_loop);
+    /* fsm.py's stock _goto_state/_check_transition/_run_transition
+       functions. The C engine compares type lookups against these to
+       decide when it may run its inlined ports; an actual subclass
+       override always dispatches through the Python method instead. */
+    if (goto_thin != NULL && goto_thin != Py_None) {
+        Py_INCREF(goto_thin);
+        Py_XSETREF(fsm_goto_state_thin, goto_thin);
+    }
+    if (check_thin != NULL && check_thin != Py_None) {
+        Py_INCREF(check_thin);
+        Py_XSETREF(fsm_check_thin, check_thin);
+    }
+    if (run_thin != NULL && run_thin != Py_None) {
+        Py_INCREF(run_thin);
+        Py_XSETREF(fsm_run_thin, run_thin);
+    }
     Py_RETURN_NONE;
+}
+
+/* True when type(fsm)'s `name` resolves to the configured stock
+   function, i.e. the C inlined port may run in its place. */
+static int
+fsm_type_uses_stock(PyObject *fsm, PyObject *name, PyObject *stock)
+{
+    if (stock == NULL)
+        return 0;
+    return _PyType_Lookup(Py_TYPE(fsm), name) == stock;
 }
 
 /* Resolve the entry function for `state` on type(fsm), with the same
@@ -1666,6 +1773,32 @@ fsm_check_transition(PyObject *fsm, PyObject *state)
     return rc;
 }
 
+/* Run the transition check / the transition itself through the C port
+   when the class uses the stock implementation, or through Python
+   method dispatch when a subclass overrides it — so custom validation
+   or instrumentation is never silently skipped by the native engine. */
+static int
+fsm_dispatch_check_transition(PyObject *fsm, PyObject *state)
+{
+    if (fsm_type_uses_stock(fsm, str_check_transition, fsm_check_thin))
+        return fsm_check_transition(fsm, state);
+    PyObject *r = PyObject_CallMethodObjArgs(fsm, str_check_transition,
+                                             state, NULL);
+    if (r == NULL)
+        return -1;
+    Py_DECREF(r);
+    return 0;
+}
+
+static PyObject *
+fsm_dispatch_run_transition(PyObject *fsm, PyObject *state)
+{
+    if (fsm_type_uses_stock(fsm, str_run_transition, fsm_run_thin))
+        return fsm_run_transition_impl(fsm, state);
+    return PyObject_CallMethodObjArgs(fsm, str_run_transition, state,
+                                      NULL);
+}
+
 /* C port of FSM._goto_state: whitelist check, re-entrant transition
    serialization via _fsm_pending, and the finally-semantics of the
    Python engine (in-transition flag cleared and stale pending hops
@@ -1673,7 +1806,7 @@ fsm_check_transition(PyObject *fsm, PyObject *state)
 static PyObject *
 fsm_goto_state_impl(PyObject *fsm, PyObject *state)
 {
-    if (fsm_check_transition(fsm, state) < 0)
+    if (fsm_dispatch_check_transition(fsm, state) < 0)
         return NULL;
 
     int err;
@@ -1711,18 +1844,18 @@ fsm_goto_state_impl(PyObject *fsm, PyObject *state)
         Py_DECREF(pending);
         return NULL;
     }
-    PyObject *r = fsm_run_transition_impl(fsm, state);
+    PyObject *r = fsm_dispatch_run_transition(fsm, state);
     int ok = (r != NULL);
     Py_XDECREF(r);
     while (ok && PyList_GET_SIZE(pending) > 0) {
         PyObject *nxt = Py_NewRef(PyList_GET_ITEM(pending, 0));
         if (PyList_SetSlice(pending, 0, 1, NULL) < 0 ||
-            fsm_check_transition(fsm, nxt) < 0) {
+            fsm_dispatch_check_transition(fsm, nxt) < 0) {
             Py_DECREF(nxt);
             ok = 0;
             break;
         }
-        r = fsm_run_transition_impl(fsm, nxt);
+        r = fsm_dispatch_run_transition(fsm, nxt);
         Py_DECREF(nxt);
         if (r == NULL) {
             ok = 0;
@@ -1771,12 +1904,15 @@ fsm_goto_state(PyObject *mod, PyObject *args)
 
 static PyMethodDef native_methods[] = {
     {"fsm_configure", (PyCFunction)fsm_configure, METH_VARARGS,
-     "Inject (StateHandle class, tracer list, get_running_loop)."},
+     "Inject (StateHandle class, tracer list, get_running_loop[, stock "
+     "_goto_state, stock _check_transition, stock _run_transition]); "
+     "the stock functions let the engine detect subclass overrides."},
     {"fsm_run_transition", (PyCFunction)fsm_run_transition, METH_VARARGS,
      "Run one FSM state transition (C port of FSM._run_transition)."},
     {"fsm_drain_state_changed", (PyCFunction)fsm_drain_state_changed,
-     METH_NOARGS,
-     "Deliver the pending batch of deferred stateChanged emissions."},
+     METH_O,
+     "Deliver the pending batch of deferred stateChanged emissions "
+     "for the given event loop."},
     {"fsm_goto_state", (PyCFunction)fsm_goto_state, METH_VARARGS,
      "Request an FSM transition (C port of FSM._goto_state)."},
     {NULL}
@@ -1834,7 +1970,21 @@ PyInit__cueball_native(void)
             PyUnicode_InternFromString("call_exception_handler")) == NULL ||
         (str_message = PyUnicode_InternFromString("message")) == NULL ||
         (str_exception =
-            PyUnicode_InternFromString("exception")) == NULL)
+            PyUnicode_InternFromString("exception")) == NULL ||
+        (str_safe_internal_on =
+            PyUnicode_InternFromString("_cueball_safe_internal_on"))
+                == NULL ||
+        (str_valid_priv = PyUnicode_InternFromString("_valid")) == NULL ||
+        (str_in_transition =
+            PyUnicode_InternFromString("_fsm_in_transition")) == NULL ||
+        (str_fsm_pending =
+            PyUnicode_InternFromString("_fsm_pending")) == NULL ||
+        (str_is_closed =
+            PyUnicode_InternFromString("is_closed")) == NULL ||
+        (str_check_transition =
+            PyUnicode_InternFromString("_check_transition")) == NULL ||
+        (str_run_transition =
+            PyUnicode_InternFromString("_run_transition")) == NULL)
         return NULL;
 
     if (PyType_Ready(&Emitter_Type) < 0 ||
@@ -1843,6 +1993,18 @@ PyInit__cueball_native(void)
         PyType_Ready(&GotoGate_Type) < 0 ||
         PyType_Ready(&SHandle_Type) < 0)
         return NULL;
+
+    /* The base `on` descriptor: emitter_internal_on_fast compares
+       against it to detect un-overridden `on` on emitter subclasses. */
+    emitter_on_descr = PyDict_GetItemWithError(Emitter_Type.tp_dict,
+                                               str_on);
+    if (emitter_on_descr == NULL) {
+        if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_RuntimeError,
+                            "EventEmitter.on descriptor missing");
+        return NULL;
+    }
+    Py_INCREF(emitter_on_descr);
 
     /* GotoGates are framework-internal listeners: make the marker
        visible to the Python-side count_listeners fallback too (the C

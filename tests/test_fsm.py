@@ -377,3 +377,127 @@ def test_dispose_all_error_keeps_remaining_disposables():
         # And the list is now empty: a third call is a no-op.
         h._dispose_all()
         assert ran == ['late'] and len(attempts) == 2
+
+
+class _AB(FSM):
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        pass
+
+    def state_b(self, S):
+        pass
+
+
+def test_goto_state_override_is_dispatched():
+    """A subclass override of _goto_state must see every transition,
+    including ones requested through a StateHandle (the native engine
+    only bypasses the stock thin wrapper, never an actual override)."""
+    calls = []
+
+    class M(_AB):
+        def _goto_state(self, state):
+            calls.append(state)
+            super()._goto_state(state)
+
+    async def t():
+        m = M()
+        assert calls == ['a']
+        m._fsm_state_handle.goto_state('b')
+        assert calls == ['a', 'b']
+        assert m.get_state() == 'b'
+    run_async(t())
+
+
+def test_is_in_state_substates():
+    """Sub-state containment: "a.b" is in "a" but not in "ab"/"a."/"b"
+    (identical on both cores; the native core rebinds FSM.is_in_state)."""
+    class M(FSM):
+        def __init__(self):
+            super().__init__('a.b')
+
+        def state_a_b(self, S):
+            pass
+
+    async def t():
+        m = M()
+        assert m.is_in_state('a.b')
+        assert m.is_in_state('a')
+        assert m.isInState('a')
+        assert not m.is_in_state('a.')
+        assert not m.is_in_state('ab')
+        assert not m.is_in_state('a.b.c')
+        assert not m.is_in_state('b')
+    run_async(t())
+
+
+def test_state_changed_batches_per_loop():
+    """Deferred stateChanged batches are tracked per event loop: a
+    transition scheduled on loop B while loop A still has an undrained
+    batch must not drop A's emissions (native regression: a single
+    global batch keyed on the last loop to schedule)."""
+    import threading
+
+    barrier = threading.Barrier(2, timeout=20)
+    results = {}
+    errors = []
+
+    def drive(name):
+        async def main():
+            got = []
+            m = _AB()
+            m.on('stateChanged', got.append)
+            barrier.wait()      # both loops alive, 'a' batches pending
+            m._goto_state('b')
+            barrier.wait()      # both loops hold undrained batches
+            await settle()
+            return got
+        try:
+            results[name] = asyncio.run(
+                asyncio.wait_for(main(), timeout=15))
+        except BaseException as e:  # surface into the main thread
+            errors.append(e)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=drive, args=(n,))
+               for n in ('one', 'two')]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert results == {'one': ['a', 'b'], 'two': ['a', 'b']}
+
+
+def test_check_and_run_transition_overrides_dispatched():
+    """Subclass overrides of _check_transition / _run_transition must be
+    dispatched by the transition engine on both cores (native
+    regression: the C goto engine inlined the stock ports
+    unconditionally, silently skipping custom validation)."""
+    calls = []
+
+    class M(_AB):
+        def _check_transition(self, state):
+            calls.append(('check', state))
+            super()._check_transition(state)
+            if state == 'forbidden':
+                raise RuntimeError('custom validation')
+
+        def _run_transition(self, state):
+            calls.append(('run', state))
+            super()._run_transition(state)
+
+    async def t():
+        m = M()
+        assert calls == [('check', 'a'), ('run', 'a')]
+        m._fsm_state_handle.goto_state('b')
+        assert calls == [('check', 'a'), ('run', 'a'),
+                         ('check', 'b'), ('run', 'b')]
+        with pytest.raises(RuntimeError, match='custom validation'):
+            m._goto_state('forbidden')
+        assert m.get_state() == 'b'
+    run_async(t())
