@@ -1,8 +1,10 @@
 # Parity with the reference's Makefile targets (reference Makefile:23-76)
 
 PYTHON ?= python3
+LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
+	examples bin/cbresolve
 
-.PHONY: test check bench dryrun coverage native
+.PHONY: test check bench dryrun coverage native ci
 
 native:
 	$(PYTHON) native/build.py
@@ -10,8 +12,19 @@ native:
 test: native
 	$(PYTHON) -m pytest tests/ -x -q
 
+# The reference gates check on jsl + jsstyle (reference Makefile:33-41);
+# cblint is the vendored equivalent (tools/cblint.py) and FAILS the
+# build on any violation.
 check:
 	$(PYTHON) -m compileall -q cueball_tpu bin/cbresolve bench.py __graft_entry__.py
+	$(PYTHON) tools/cblint.py $(LINT_TARGETS)
+
+# The full CI gate, runnable locally: build from source, lint, test on
+# both cores, dryrun the multichip sharding path.
+ci: native check
+	$(PYTHON) -m pytest tests/ -x -q
+	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -x -q
+	$(MAKE) dryrun
 
 bench:
 	$(PYTHON) bench.py

@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import logging
 import math
-import typing
 
 from . import errors as mod_errors
 from . import utils as mod_utils

@@ -9,7 +9,6 @@ label-keyed counters/gauges/histograms with a text-format serializer.
 from __future__ import annotations
 
 import threading
-import typing
 
 
 def _label_key(labels: dict | None) -> tuple:

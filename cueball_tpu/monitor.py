@@ -12,7 +12,6 @@ over HTTP (GET /kang/snapshot).
 from __future__ import annotations
 
 import socket as mod_socket
-import time
 
 
 class PoolMonitor:

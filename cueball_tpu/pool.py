@@ -27,7 +27,6 @@ from __future__ import annotations
 import logging
 import math
 import time
-import typing
 import uuid as mod_uuid
 
 from . import codel as mod_codel

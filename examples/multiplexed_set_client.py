@@ -27,7 +27,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import cueball_tpu as cb
 from cueball_tpu.events import EventEmitter
-from cueball_tpu.fsm import get_loop
 
 
 # ---------------------------------------------------------------------------

@@ -1012,7 +1012,19 @@ Emitter_is_in_state(EmitterObject *self, PyObject *state)
                 Py_XDECREF(strong);
                 return NULL;
             }
-            res = eq;
+            if (eq) {
+                res = 1;
+            } else {
+                /* The Python body does len(state) next; propagate the
+                   same TypeError for unsized states (is_in_state(None)
+                   is a caller bug that must surface, not read False). */
+                Py_ssize_t ls = PyObject_Size(state);
+                if (ls < 0) {
+                    Py_XDECREF(strong);
+                    return NULL;
+                }
+                res = 0;
+            }
         }
     }
     Py_XDECREF(strong);
@@ -1364,11 +1376,10 @@ drain_prune_closed(void)
 static int
 fsm_schedule_state_changed(PyObject *loop, PyObject *fsm, PyObject *state)
 {
-    if (drain_map == NULL) {
-        drain_map = PyDict_New();
-        if (drain_map == NULL)
-            return -1;
-    }
+    /* drain_map is allocated once in PyInit: lazy creation here could
+       race two threads' first transitions (a GC pass inside PyDict_New
+       can switch the GIL), one thread's fresh dict overwriting the
+       other's already-scheduled batch. */
     PyObject *batch = PyDict_GetItemWithError(drain_map, loop);
     if (batch != NULL) {
         /* Existing batch: its drain is already scheduled. */
@@ -2005,6 +2016,12 @@ PyInit__cueball_native(void)
         return NULL;
     }
     Py_INCREF(emitter_on_descr);
+
+    /* Allocated once here, never lazily (see
+       fsm_schedule_state_changed). */
+    drain_map = PyDict_New();
+    if (drain_map == NULL)
+        return NULL;
 
     /* GotoGates are framework-internal listeners: make the marker
        visible to the Python-side count_listeners fallback too (the C

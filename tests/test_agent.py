@@ -15,7 +15,7 @@ import pytest
 from cueball_tpu.agent import HttpAgent, HttpsAgent
 from cueball_tpu import errors as mod_errors
 
-from conftest import run_async, settle
+from conftest import run_async
 
 
 RECOVERY = {'default': {'timeout': 2000, 'retries': 2, 'delay': 100,

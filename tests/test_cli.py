@@ -5,7 +5,6 @@ since the CLI is the one surface operators touch directly)."""
 import subprocess
 import sys
 
-import pytest
 
 REPO = __file__.rsplit('/', 2)[0]
 

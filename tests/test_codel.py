@@ -6,7 +6,7 @@ import time
 
 import pytest
 
-from cueball_tpu.codel import ControlledDelay, CODEL_INTERVAL
+from cueball_tpu.codel import ControlledDelay
 from cueball_tpu.utils import current_millis
 
 

@@ -10,7 +10,7 @@ import pytest
 from cueball_tpu import dns_resolver as mod_dns
 from cueball_tpu.dns_resolver import DNSResolver
 
-from conftest import run_async, settle, wait_for_state
+from conftest import run_async, wait_for_state
 from fake_dns import Cfg, FakeDnsClient
 
 

@@ -429,6 +429,11 @@ def test_is_in_state_substates():
         assert not m.is_in_state('ab')
         assert not m.is_in_state('a.b.c')
         assert not m.is_in_state('b')
+        # A non-string state is a caller bug: both cores surface it
+        # (the Python body via len(state), the C port via the same
+        # TypeError) rather than silently reading False.
+        with pytest.raises(TypeError):
+            m.is_in_state(None)
     run_async(t())
 
 

@@ -12,7 +12,6 @@ import jax.numpy as jnp  # noqa: E402
 from cueball_tpu.ops import (gen_taps, fir_apply, fir_apply_pallas,
                              fir_smooth, backoff_schedule, spread_delays,
                              codel_scan)
-from cueball_tpu.ops.codel_batch import codel_init
 from cueball_tpu.pool import FIRFilter, gen_taps as gen_taps_py
 from cueball_tpu import codel as mod_codel
 from cueball_tpu import utils as mod_utils

@@ -4,8 +4,6 @@ shrink clamp, and option clamping (reference lib/pool.js:44-100,
 driving the mechanisms directly rather than waiting out the 60 s
 shuffle timer / 5 Hz sampler."""
 
-import asyncio
-
 from conftest import run_async, settle, wait_for_state
 
 from test_pool import Ctx, make_pool

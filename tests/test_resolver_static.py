@@ -1,4 +1,5 @@
-"""Static resolver tests (ported from reference test/resolver_static.test.js)."""
+"""Static resolver tests (ported from reference
+test/resolver_static.test.js)."""
 
 import pytest
 

@@ -19,7 +19,6 @@ import pytest
 from cueball_tpu.dns_client import (DnsError, DnsMessage,
                                     DnsTimeoutError)
 from cueball_tpu.dns_resolver import DNSResolver
-from cueball_tpu import dns_resolver as mod_dns
 
 from conftest import run_async, wait_for_state
 

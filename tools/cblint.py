@@ -1,0 +1,243 @@
+#!/usr/bin/env python3
+"""cblint — the in-tree lint gate for cueball_tpu.
+
+The reference gates `make check` on two vendored tools: jsl (a
+correctness lint, config tools/jsl.node.conf) and jsstyle (Joyent's
+in-tree style checker) — reference Makefile:33-41. This environment
+ships no Python linter, so, like the reference, we vendor one: a
+focused checker with a correctness half (AST-based, the jsl analogue)
+and a style half (line-based, the jsstyle analogue).
+
+Exit status is non-zero iff any violation is found. Suppress a single
+line with a trailing ``# cblint: ignore`` (the jsstyle
+``/* JSSTYLED */`` analogue).
+
+Usage: cblint.py [paths...]   (directories are walked for *.py)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+MAX_LINE = 79
+SUPPRESS = '# cblint: ignore'
+
+
+class Violation:
+    def __init__(self, path, line, code, msg):
+        self.path = path
+        self.line = line
+        self.code = code
+        self.msg = msg
+
+    def __str__(self):
+        return '%s:%d: %s %s' % (self.path, self.line, self.code,
+                                 self.msg)
+
+
+def check_style(path: str, text: str) -> list[Violation]:
+    """The jsstyle half: mechanical per-line rules."""
+    out = []
+    lines = text.split('\n')
+    for i, line in enumerate(lines, 1):
+        if line.endswith(SUPPRESS):
+            continue
+        if line.rstrip('\r') != line.rstrip('\r').rstrip():
+            out.append(Violation(path, i, 'S002', 'trailing whitespace'))
+        if line.endswith('\r'):
+            out.append(Violation(path, i, 'S005', 'CRLF line ending'))
+        stripped = line.expandtabs()
+        if '\t' in line[:len(line) - len(line.lstrip())]:
+            out.append(Violation(path, i, 'S003', 'tab in indentation'))
+        if len(stripped) > MAX_LINE:
+            out.append(Violation(
+                path, i, 'S001',
+                'line too long (%d > %d)' % (len(stripped), MAX_LINE)))
+    if text and not text.endswith('\n'):
+        out.append(Violation(path, len(lines), 'S004',
+                             'no newline at end of file'))
+    if text.endswith('\n\n\n'):
+        out.append(Violation(path, len(lines), 'S006',
+                             'multiple blank lines at end of file'))
+    return out
+
+
+class _CorrectnessVisitor(ast.NodeVisitor):
+    """The jsl half: AST rules that catch real bugs."""
+
+    def __init__(self, path, suppressed_lines):
+        self.path = path
+        self.suppressed = suppressed_lines
+        self.out = []
+        # import bookkeeping: alias -> (lineno, dotted name)
+        self.imports = {}
+        self.used_names = set()
+        self.export_all = False
+
+    def _add(self, node, code, msg):
+        if node.lineno in self.suppressed:
+            return
+        self.out.append(Violation(self.path, node.lineno, code, msg))
+
+    # -- unused imports ---------------------------------------------------
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = a.asname or a.name.split('.')[0]
+            self.imports.setdefault(name, (node.lineno, a.name))
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == '__future__':
+            return
+        for a in node.names:
+            if a.name == '*':
+                self.export_all = True
+                continue
+            name = a.asname or a.name
+            self.imports.setdefault(name, (node.lineno, a.name))
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used_names.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+    # -- classic bug patterns ---------------------------------------------
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def _check_defaults(self, node):
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self._add(d, 'C102',
+                          'mutable default argument (shared across '
+                          'calls)')
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self._add(node, 'C103',
+                      'bare except: (catches SystemExit/KeyboardInterrupt;'
+                      ' use "except Exception" or narrower)')
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Is, ast.IsNot)):
+                # None/True/False are singletons: `is` is idiomatic.
+                if isinstance(comp, ast.Constant) and \
+                        comp.value is not None and \
+                        not isinstance(comp.value, bool) and \
+                        isinstance(comp.value, (str, int, float, bytes)):
+                    self._add(node, 'C104',
+                              '"is" comparison with a literal '
+                              '(identity is not equality)')
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        if not any(isinstance(v, ast.FormattedValue)
+                   for v in node.values):
+            self._add(node, 'C105', 'f-string without placeholders')
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self._add(node, 'C107',
+                      'assert on a non-empty tuple is always true')
+        self.generic_visit(node)
+
+    def visit_Dict(self, node):
+        seen = {}
+        for k in node.keys:
+            if isinstance(k, ast.Constant):
+                try:
+                    hash(k.value)
+                except TypeError:
+                    continue
+                if k.value in seen:
+                    self._add(k, 'C108',
+                              'duplicate dict key %r' % (k.value,))
+                seen[k.value] = True
+        self.generic_visit(node)
+
+    def finish(self, tree, text):
+        # __all__ strings and docstring/annotation references count as
+        # uses; so does any appearance of the name in a string (covers
+        # typing forward refs without a resolver).
+        if self.export_all:
+            return
+        for s in ast.walk(tree):
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                self.used_names.update(s.value.replace('.', ' ').split())
+        for name, (lineno, dotted) in self.imports.items():
+            if name.startswith('_'):
+                continue
+            if name not in self.used_names:
+                if lineno in self.suppressed:
+                    continue
+                self.out.append(Violation(
+                    self.path, lineno, 'C101',
+                    'imported but unused: %s' % dotted))
+
+
+def check_correctness(path: str, text: str) -> list[Violation]:
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, 'C100',
+                          'syntax error: %s' % e.msg)]
+    suppressed = {i for i, line in enumerate(text.split('\n'), 1)
+                  if line.endswith(SUPPRESS)}
+    v = _CorrectnessVisitor(path, suppressed)
+    v.visit(tree)
+    v.finish(tree, text)
+    return v.out
+
+
+def lint_file(path: Path) -> list[Violation]:
+    text = path.read_text(encoding='utf-8')
+    return check_style(str(path), text) + \
+        check_correctness(str(path), text)
+
+
+def iter_targets(args: list[str]):
+    for a in args:
+        p = Path(a)
+        if p.is_dir():
+            yield from sorted(p.rglob('*.py'))
+        else:
+            yield p
+
+
+def main(argv: list[str]) -> int:
+    targets = list(iter_targets(argv)) or []
+    if not targets:
+        print('cblint: no targets', file=sys.stderr)
+        return 2
+    violations = []
+    for t in targets:
+        violations.extend(lint_file(t))
+    for v in violations:
+        print(v)
+    if violations:
+        print('cblint: %d violation(s) in %d file(s)' % (
+            len(violations), len({v.path for v in violations})))
+        return 1
+    print('cblint: %d file(s) clean' % len(targets))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
