@@ -252,8 +252,9 @@ class CueBallAgent(EventEmitter):
         self.pools: dict[str, ConnectionPool] = {}
         self.pool_resolvers: dict[str, object] = {}
         self.resolvers = options.get('resolvers')
-        self.log = options.get('log') or logging.getLogger(
-            'cueball.agent')
+        self.log = mod_utils.make_child_logger(
+            options.get('log') or logging.getLogger('cueball.agent'),
+            component='CueBallAgent')
         self.cba_stopped = False
         self.maximum = maximum
         self.spares = spares

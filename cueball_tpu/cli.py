@@ -26,6 +26,24 @@ from .resolver import (StaticIpResolver, config_for_ip_or_domain,
                        parse_ip_or_domain)
 
 
+def parse_time_interval(s: str) -> int:
+    """Duration string -> milliseconds: a positive integer with an
+    optional "ms"/"s"/"m" suffix ("500", "30s", "5m"); bare numbers are
+    milliseconds (reference bin/cbresolve:301-328 parseTimeInterval)."""
+    import re
+    m = re.match(r'^([1-9][0-9]*)(ms|s|m)?$', s)
+    if m is None:
+        raise argparse.ArgumentTypeError(
+            'invalid time interval: %s' % s)
+    n = int(m.group(1))
+    unit = m.group(2)
+    if unit == 's':
+        n *= 1000
+    elif unit == 'm':
+        n *= 60000
+    return n
+
+
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog='cbresolve',
@@ -42,8 +60,10 @@ def _build_parser() -> argparse.ArgumentParser:
                    help='comma-separated list of DNS resolvers')
     p.add_argument('-s', '--service', default=None,
                    help='"service" name for SRV lookups (_foo._tcp)')
-    p.add_argument('-t', '--timeout', type=float, default=5000,
-                   help='timeout for lookups (ms)')
+    p.add_argument('-t', '--timeout', type=parse_time_interval,
+                   default=5000, metavar='TIMEOUT',
+                   help='timeout for lookups (e.g. 500, 500ms, 30s, 5m;'
+                        ' bare numbers are milliseconds)')
     p.add_argument('-k', '--kang-port', type=int, default=None,
                    help='start a kang debug listener on this port')
     return p

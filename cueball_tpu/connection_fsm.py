@@ -131,8 +131,15 @@ class SocketMgrFSM(FSM):
         self.sm_initial_recov = initial_recov
         self.sm_connect_recov = connect_recov
 
-        self.sm_log = options.get('log') or logging.getLogger(
-            'cueball.socketmgr')
+        # Backend identity rides on every record
+        # (reference lib/connection-fsm.js:149-155); a localPort child
+        # is layered on at connect time (state_connected).
+        self.sm_log = mod_utils.make_child_logger(
+            options.get('log') or logging.getLogger('cueball.socketmgr'),
+            component='CueBallSocketMgrFSM',
+            backend=self.sm_backend.get('key'),
+            address=self.sm_backend.get('address'),
+            port=self.sm_backend.get('port'))
 
         self.sm_last_error = None
         self.sm_socket = None
@@ -379,8 +386,10 @@ class CueBallClaimHandle(FSM):
             raise AssertionError('options.callback must be callable')
         self.ch_callback = callback
 
-        self.ch_log = options.get('log') or logging.getLogger(
-            'cueball.claimhandle')
+        self.ch_log = mod_utils.make_child_logger(
+            options.get('log') or logging.getLogger(
+                'cueball.claimhandle'),
+            component='CueBallClaimHandle')
 
         self.ch_slot = None
         self.ch_waiter_node = None  # pool claim-queue node (O(1) unlink)
@@ -610,8 +619,12 @@ class ConnectionSlotFSM(FSM):
         self.csf_checker = options.get('checker')
         self.csf_check_timeout = options.get('checkTimeout')
 
-        self.csf_log = options.get('log') or logging.getLogger(
-            'cueball.slot')
+        self.csf_log = mod_utils.make_child_logger(
+            options.get('log') or logging.getLogger('cueball.slot'),
+            component='CueBallConnectionSlotFSM',
+            backend=self.csf_backend.get('key'),
+            address=self.csf_backend.get('address'),
+            port=self.csf_backend.get('port'))
 
         self.csf_smgr = SocketMgrFSM({
             'pool': options['pool'],

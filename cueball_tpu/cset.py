@@ -61,8 +61,11 @@ class ConnectionSet(FSM):
         self.cs_conn_handles_err = bool(
             options.get('connectionHandlesError'))
 
-        self.cs_log = options.get('log') or logging.getLogger(
-            'cueball.cset')
+        self.cs_log = mod_utils.make_child_logger(
+            options.get('log') or logging.getLogger('cueball.cset'),
+            component='CueBallConnectionSet',
+            domain=options.get('domain'),
+            service=options.get('service'), cset=self.cs_uuid)
         self.cs_domain = options.get('domain')
 
         self.cs_collector = mod_utils.create_error_metrics(options)

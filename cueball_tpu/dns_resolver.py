@@ -133,8 +133,9 @@ class DNSResolverFSM(FSM):
             self.r_maxres = 10
             self.r_ref_count = 0
 
-        self.r_log = options.get('log') or logging.getLogger(
-            'cueball.dns')
+        self.r_log = mod_utils.make_child_logger(
+            options.get('log') or logging.getLogger('cueball.dns'),
+            component='CueBallDNSResolver', domain=self.r_domain)
 
         recovery = options.get('recovery')
         if not isinstance(recovery, dict):

@@ -154,7 +154,12 @@ class ConnectionPool(FSM):
             raise AssertionError('options.recovery.default is required')
         self.p_recovery = recovery
 
-        self.p_log = options.get('log') or logging.getLogger('cueball.pool')
+        # Child logger carrying pool identity into every record
+        # (reference lib/pool.js:152-157).
+        self.p_log = mod_utils.make_child_logger(
+            options.get('log') or logging.getLogger('cueball.pool'),
+            component='CueBallConnectionPool', domain=domain,
+            service=options.get('service'), pool=self.p_uuid)
 
         self.p_collector = mod_utils.create_error_metrics(options)
 

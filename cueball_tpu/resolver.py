@@ -111,8 +111,9 @@ class ResolverFSM(FSM):
         options = options or {}
         self.r_fsm = inner
         self.r_last_error = None
-        self.r_log = options.get('log') or logging.getLogger(
-            'cueball.resolver')
+        self.r_log = mod_utils.make_child_logger(
+            options.get('log') or logging.getLogger('cueball.resolver'),
+            component='CueBallResolver')
         super().__init__('stopped')
         # Always-on forwarding, independent of wrapper state
         # (reference lib/resolver.js:72-73).

@@ -12,12 +12,49 @@ Rebuild of reference `lib/utils.js`:
 
 from __future__ import annotations
 
+import logging
 import math
 import random
 import time
 import traceback
 
 from . import metrics as mod_metrics
+
+
+# ---------------------------------------------------------------------------
+# Contextual child loggers (the bunyan log.child analogue)
+#
+# The reference binds component/backend/localPort context into every log
+# record via bunyan child loggers (reference lib/pool.js:152-157,
+# lib/connection-fsm.js:149-155,913-918). The stdlib analogue is a
+# LoggerAdapter: context rides on the record (record.cueball, for
+# structured handlers) and is prefixed into the message (for plain
+# formatters). Children of children merge their context.
+
+class ContextLogger(logging.LoggerAdapter):
+    def process(self, msg, kwargs):
+        extra = kwargs.get('extra')
+        if extra is None:
+            kwargs['extra'] = extra = {}
+        extra.setdefault('cueball', self.extra)
+        if self.extra:
+            ctx = ' '.join(
+                '%s=%s' % (k, v) for k, v in self.extra.items())
+            msg = '[%s] %s' % (ctx, msg)
+        return msg, kwargs
+
+
+def make_child_logger(log, **context):
+    """Return a logger carrying `log`'s context plus `context`
+    (reference bunyan log.child). Accepts a plain Logger, a
+    ContextLogger, or None (falls back to the 'cueball' logger)."""
+    if log is None:
+        log = logging.getLogger('cueball')
+    if isinstance(log, logging.LoggerAdapter):
+        merged = dict(log.extra or {})
+        merged.update(context)
+        return ContextLogger(log.logger, merged)
+    return ContextLogger(log, dict(context))
 
 # ---------------------------------------------------------------------------
 # assert-plus style validation

@@ -88,3 +88,30 @@ def test_dns_mode_end_to_end_over_wire():
         assert '8080' in out.decode()
 
     asyncio.run(t())
+
+
+def test_parse_time_interval():
+    """Duration strings -> ms (reference bin/cbresolve:301-328)."""
+    import argparse
+    import pytest
+    from cueball_tpu.cli import parse_time_interval
+
+    assert parse_time_interval('500') == 500
+    assert parse_time_interval('250ms') == 250
+    assert parse_time_interval('30s') == 30000
+    assert parse_time_interval('5m') == 300000
+    assert parse_time_interval('1s') == 1000
+    for bad in ('0', '-5', '5h', 'abc', '1.5s', '', '05', 's', '10 s'):
+        with pytest.raises(argparse.ArgumentTypeError):
+            parse_time_interval(bad)
+
+
+def test_timeout_flag_accepts_durations():
+    """-t accepts suffixed durations on the real CLI (wire-level)."""
+    out = run_cli('-S', '-t', '30s', '127.0.0.1:8080')
+    assert out.returncode == 0, out.stderr
+    assert '127.0.0.1' in out.stdout
+
+    bad = run_cli('-S', '-t', '5h', '127.0.0.1:8080')
+    assert bad.returncode == 2
+    assert 'invalid time interval' in bad.stderr
