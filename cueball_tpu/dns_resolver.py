@@ -40,6 +40,7 @@ import time
 import uuid as mod_uuid
 
 from . import dns_client as mod_nsc
+from . import utils as mod_utils
 from .events import EventEmitter
 from .fsm import FSM
 from .utils import delay as gen_delay

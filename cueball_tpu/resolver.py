@@ -29,6 +29,7 @@ import hashlib
 import ipaddress
 import logging
 
+from . import utils as mod_utils
 from .errors import CueBallError
 from .events import EventEmitter
 from .fsm import FSM
