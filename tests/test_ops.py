@@ -46,6 +46,37 @@ def test_fir_pallas_matches_jnp():
         np.asarray(fir_apply(w, taps)), rtol=1e-4)
 
 
+def test_fleet_step_pallas_variant_matches_xla():
+    """The two forced fleet_step FIR variants (bench.py's head-to-head;
+    the TPU default is the measured winner) agree on every output."""
+    from cueball_tpu.parallel import fleet_init, fleet_inputs
+    from cueball_tpu.parallel.telemetry import (fleet_step_pallas,
+                                                fleet_step_xla)
+    rng = np.random.default_rng(11)
+    n = 16
+    inp = fleet_inputs(
+        n,
+        samples=rng.uniform(0, 8, n).astype(np.float32),
+        sojourns=rng.uniform(0, 400, n).astype(np.float32),
+        target_delay=np.full(n, 200.0, np.float32),
+        spares=np.full(n, 2.0, np.float32),
+        active=np.ones(n, bool),
+        now_ms=np.float32(1000.0))
+    state = fleet_init(n)
+    sx, ox, fx = fleet_step_xla(state, inp)
+    sp, op_, fp = fleet_step_pallas(state, inp)
+    np.testing.assert_allclose(np.asarray(sx.windows),
+                               np.asarray(sp.windows), rtol=1e-5)
+    for k in ox:
+        np.testing.assert_allclose(np.asarray(ox[k]),
+                                   np.asarray(op_[k]), rtol=1e-4,
+                                   err_msg=k)
+    for k in fx:
+        np.testing.assert_allclose(np.asarray(fx[k]),
+                                   np.asarray(fp[k]), rtol=1e-4,
+                                   err_msg=k)
+
+
 def test_fir_smooth_shape_and_tail():
     rng = np.random.default_rng(3)
     series = jnp.asarray(rng.uniform(0, 5, size=(4, 200)), jnp.float32)
