@@ -58,8 +58,19 @@ from .pool import ConnectionPool
 from .monitor import pool_monitor
 from .cset import ConnectionSet
 from .agent import HttpAgent, HttpsAgent
+from .debug import (
+    dump_fsm_histories,
+    install_debug_handler,
+    init_from_env as _debug_init_from_env,
+)
 
 __version__ = '1.0.0'
+
+# Live-attach diagnostics (reference lib/utils.js:59-99 dtrace probe
+# analogue): CUEBALL_STACK_TRACES=1 enables claim stack capture at
+# startup; CUEBALL_DEBUG_SIGNAL=1 (or a signal name) installs a handler
+# that toggles capture and dumps all FSM histories on each delivery.
+_debug_init_from_env()
 
 # camelCase aliases matching the reference's exact export names
 # (reference lib/index.js:17-38), for drop-in familiarity.
@@ -75,6 +86,7 @@ __all__ = [
     'resolverForIpOrDomain', 'configForIpOrDomain',
     'HttpAgent', 'HttpsAgent',
     'pool_monitor', 'poolMonitor', 'enableStackTraces',
+    'dump_fsm_histories', 'install_debug_handler',
     'EventEmitter', 'FSM', 'Queue', 'ControlledDelay',
     'enable_stack_traces', 'stack_traces_enabled', 'current_millis',
     'plan_rebalance',

@@ -1,0 +1,155 @@
+"""Runtime observability attach: flip diagnostics on a LIVE process.
+
+The reference enables claim stack-trace capture on a running process
+with zero code change by attaching a dtrace probe (reference
+lib/utils.js:59-99: the `capture-stack` USDT probe flips
+`stackTracesEnabled` from outside). Python has no USDT, so the
+equivalent external attach points are:
+
+- **a signal** — :func:`install_debug_handler` binds SIGUSR2 (by
+  default); each delivery toggles process-wide stack capture
+  (utils.enable_stack_traces) and dumps the FSM state + history ring of
+  every pool, set, resolver and connection slot registered with the
+  process-global pool monitor to the ``cueball.debug`` logger, so an
+  operator can `kill -USR2 <pid>` a wedged process and read what every
+  FSM did last.
+- **environment variables** — read once at `import cueball_tpu`:
+  ``CUEBALL_STACK_TRACES=1`` starts with capture enabled, and
+  ``CUEBALL_DEBUG_SIGNAL=1`` (or a signal name like ``SIGUSR1``)
+  installs the handler without any application code.
+
+The dump itself is also callable in-process (:func:`dump_fsm_histories`)
+and is what the kang surface uses for ad-hoc archaeology.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import signal
+import time
+
+from . import utils as mod_utils
+
+_LOG = logging.getLogger('cueball.debug')
+
+
+def _fsm_line(tag: str, fsm) -> str:
+    try:
+        state = fsm.get_state()
+    except Exception:
+        state = '?'
+    hist = []
+    get_history = getattr(fsm, 'get_history', None)
+    if get_history is not None:
+        try:
+            hist = get_history()
+        except Exception:
+            pass
+    return '  %-14s state=%-12s history=%s\n' % (tag, state,
+                                                 '->'.join(hist))
+
+
+def dump_fsm_histories(stream=None) -> str:
+    """Dump state + history of every FSM registered with the pool
+    monitor (pools, sets, DNS resolvers, and their connection slots and
+    socket managers). Returns the report; also writes it to `stream`
+    when given."""
+    from .monitor import pool_monitor
+
+    buf = io.StringIO()
+    buf.write('cueball FSM dump pid=%d t=%.3f stack_traces=%s\n' % (
+        os.getpid(), time.time(), mod_utils.stack_traces_enabled()))
+
+    for uuid, pool in list(pool_monitor.pm_pools.items()):
+        buf.write('pool %s domain=%s\n' % (uuid, pool.p_domain))
+        buf.write(_fsm_line('(pool)', pool))
+        for key, slots in list(pool.p_connections.items()):
+            for slot in slots:
+                buf.write(_fsm_line('slot %s' % key[:12], slot))
+                smgr = getattr(slot, 'csf_smgr', None)
+                if smgr is not None:
+                    buf.write(_fsm_line(' smgr', smgr))
+        if pool.p_dead:
+            buf.write('  dead=%s\n' % sorted(pool.p_dead.keys()))
+
+    for uuid, cset in list(pool_monitor.pm_sets.items()):
+        buf.write('set %s domain=%s\n' % (uuid, cset.cs_domain))
+        buf.write(_fsm_line('(set)', cset))
+        for key, slot in list(cset.cs_fsm.items()):
+            buf.write(_fsm_line('slot %s' % key[:12], slot))
+            smgr = getattr(slot, 'csf_smgr', None)
+            if smgr is not None:
+                buf.write(_fsm_line(' smgr', smgr))
+
+    for uuid, res in list(pool_monitor.pm_dns_res.items()):
+        buf.write('dns_res %s domain=%s\n' % (uuid, res.r_domain))
+        buf.write(_fsm_line('(resolver)', res))
+
+    report = buf.getvalue()
+    if stream is not None:
+        stream.write(report)
+    return report
+
+
+def _emit_dump(signum: int) -> None:
+    _LOG.warning('debug signal %d: stack traces now %s\n%s',
+                 signum,
+                 'ENABLED' if mod_utils.stack_traces_enabled()
+                 else 'disabled',
+                 dump_fsm_histories())
+
+
+def _on_debug_signal(signum, frame) -> None:
+    """SIGUSR2 handler: toggle stack capture, dump all FSM histories.
+
+    The toggle itself is plain Python state (safe at any interrupt
+    point); the dump + logging are NOT reentrancy-safe (a buffered
+    stream write interrupted mid-write raises RuntimeError), so when an
+    asyncio loop is running they are deferred to it via call_soon and
+    only run inline as a last resort."""
+    if mod_utils.stack_traces_enabled():
+        mod_utils.disable_stack_traces()
+    else:
+        mod_utils.enable_stack_traces()
+    import asyncio
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        loop = None
+    if loop is not None:
+        loop.call_soon(_emit_dump, signum)
+    else:
+        _emit_dump(signum)
+
+
+def install_debug_handler(signum: int = signal.SIGUSR2):
+    """Install the live-attach diagnostic handler (dtrace-probe
+    analogue). Returns the previous handler."""
+    return signal.signal(signum, _on_debug_signal)
+
+
+def uninstall_debug_handler(prev, signum: int = signal.SIGUSR2) -> None:
+    signal.signal(signum, prev)
+
+
+def init_from_env(env=os.environ) -> None:
+    """Apply CUEBALL_STACK_TRACES / CUEBALL_DEBUG_SIGNAL. Called once at
+    package import so both work with zero application code. Bad values
+    (unknown signal name, import off the main thread) must not make the
+    package unimportable: they log and continue."""
+    if env.get('CUEBALL_STACK_TRACES', '') not in ('', '0'):
+        mod_utils.enable_stack_traces()
+    sig = env.get('CUEBALL_DEBUG_SIGNAL', '')
+    if sig and sig != '0':
+        try:
+            name = sig.upper()
+            if not name.startswith('SIG'):
+                name = 'SIG' + name
+            signum = signal.SIGUSR2 if sig == '1' \
+                else getattr(signal, name)
+            install_debug_handler(signum)
+        except (AttributeError, ValueError, OSError) as e:
+            _LOG.warning(
+                'CUEBALL_DEBUG_SIGNAL=%s not installed: %s', sig, e)

@@ -1,0 +1,173 @@
+"""Runtime observability attach (reference lib/utils.js:59-99 dtrace
+probe analogue): signal/env toggles for stack capture, whole-process FSM
+history dumps, and contextual child loggers."""
+
+import asyncio
+import logging
+import os
+import signal
+
+import cueball_tpu as cb
+from cueball_tpu import debug as mod_debug
+from cueball_tpu import utils as mod_utils
+from cueball_tpu.events import EventEmitter
+
+from conftest import run_async
+
+
+class InstantConnection(EventEmitter):
+    def __init__(self, backend):
+        super().__init__()
+        self.backend = backend
+        asyncio.get_running_loop().call_soon(lambda: self.emit('connect'))
+
+    def destroy(self):
+        pass
+
+    def unref(self):
+        pass
+
+
+def build_pool(**opts):
+    res = cb.StaticIpResolver({
+        'backends': [{'address': '127.0.0.1', 'port': 1111}]})
+    pool = cb.ConnectionPool({
+        'domain': 'debug.test', 'resolver': res,
+        'constructor': InstantConnection,
+        'spares': 1, 'maximum': 2,
+        'recovery': {'default': {'timeout': 1000, 'retries': 1,
+                                 'delay': 50}},
+        **opts})
+    res.start()
+    return pool, res
+
+
+async def settle(pool):
+    while not pool.is_in_state('running'):
+        await asyncio.sleep(0.005)
+
+
+def test_dump_covers_pool_slots_and_history():
+    async def t():
+        pool, res = build_pool()
+        await settle(pool)
+        report = cb.dump_fsm_histories()
+        assert 'domain=debug.test' in report
+        assert '(pool)' in report and 'state=running' in report
+        # Slot + socket-manager lines with their history rings.
+        assert 'slot ' in report and 'smgr' in report
+        assert 'starting->running' in report      # pool history
+        assert 'connecting->connected' in report  # smgr history
+        pool.stop()
+    run_async(t())
+
+
+def test_signal_toggles_capture_and_dumps(caplog):
+    async def t():
+        pool, res = build_pool()
+        await settle(pool)
+        assert not mod_utils.stack_traces_enabled()
+        prev = cb.install_debug_handler(signal.SIGUSR2)
+        try:
+            with caplog.at_level(logging.WARNING, logger='cueball.debug'):
+                os.kill(os.getpid(), signal.SIGUSR2)
+                await asyncio.sleep(0.05)   # let the handler run
+                assert mod_utils.stack_traces_enabled()
+
+                # While enabled, a claim captures a REAL stack.
+                hdl, conn = await pool.claim()
+                assert 'test_debug' in '\n'.join(hdl.ch_claim_stack)
+                hdl.release()
+
+                os.kill(os.getpid(), signal.SIGUSR2)
+                await asyncio.sleep(0.05)
+                assert not mod_utils.stack_traces_enabled()
+
+                # Back off: claims carry the fixed placeholder again.
+                hdl, conn = await pool.claim()
+                assert 'disabled' in hdl.ch_claim_stack[0]
+                hdl.release()
+        finally:
+            mod_debug.uninstall_debug_handler(prev, signal.SIGUSR2)
+            mod_utils.disable_stack_traces()
+        dumps = [r for r in caplog.records
+                 if 'debug signal' in r.getMessage()]
+        assert len(dumps) == 2
+        assert 'domain=debug.test' in dumps[0].getMessage()
+        pool.stop()
+    run_async(t())
+
+
+def test_init_from_env():
+    assert not mod_utils.stack_traces_enabled()
+    try:
+        mod_debug.init_from_env({'CUEBALL_STACK_TRACES': '1'})
+        assert mod_utils.stack_traces_enabled()
+    finally:
+        mod_utils.disable_stack_traces()
+    # '0' and empty are off; no signal handler requested -> no change.
+    mod_debug.init_from_env({'CUEBALL_STACK_TRACES': '0'})
+    assert not mod_utils.stack_traces_enabled()
+
+    prev = signal.getsignal(signal.SIGUSR1)
+    try:
+        mod_debug.init_from_env({'CUEBALL_DEBUG_SIGNAL': 'USR1'})
+        assert signal.getsignal(signal.SIGUSR1) is mod_debug._on_debug_signal
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+def test_child_loggers_carry_backend_context(caplog):
+    """Log records from slot/smgr/pool level carry bound context the way
+    the reference's bunyan child loggers do (reference
+    lib/pool.js:148-157, lib/connection-fsm.js:149-154)."""
+    async def t():
+        pool, res = build_pool()
+        await settle(pool)
+        slots = next(iter(pool.p_connections.values()))
+        smgr = slots[0].csf_smgr
+        with caplog.at_level(logging.INFO, logger='cueball'):
+            pool.p_log.info('pool-side message')
+            smgr.sm_log.info('smgr-side message')
+        pool_rec = next(r for r in caplog.records
+                        if 'pool-side' in r.getMessage())
+        smgr_rec = next(r for r in caplog.records
+                        if 'smgr-side' in r.getMessage())
+        # Context rides the record for structured handlers...
+        assert pool_rec.cueball.get('domain') == 'debug.test'
+        assert smgr_rec.cueball.get('address') == '127.0.0.1'
+        assert smgr_rec.cueball.get('port') == 1111
+        # ...and is prefixed into the message for plain formatters.
+        assert 'address=127.0.0.1' in smgr_rec.getMessage()
+        pool.stop()
+    run_async(t())
+
+
+def test_soak_live_toggle_under_claim_load():
+    """Claim/release continuously while an external 'operator' flips the
+    debug signal several times mid-flight: every claim completes, and
+    each handle's captured stack matches the capture mode in force when
+    it was claimed."""
+    async def t():
+        pool, res = build_pool()
+        await settle(pool)
+        prev = cb.install_debug_handler(signal.SIGUSR2)
+        real, fake = 0, 0
+        try:
+            for i in range(120):
+                if i % 30 == 15:
+                    os.kill(os.getpid(), signal.SIGUSR2)
+                    await asyncio.sleep(0)
+                hdl, conn = await pool.claim()
+                if 'disabled' in hdl.ch_claim_stack[0]:
+                    fake += 1
+                else:
+                    real += 1
+                hdl.release()
+        finally:
+            mod_debug.uninstall_debug_handler(prev, signal.SIGUSR2)
+            mod_utils.disable_stack_traces()
+        # 4 toggles at 15/45/75/105: ~half the claims in each mode.
+        assert real >= 30 and fake >= 30
+        pool.stop()
+    run_async(t())
