@@ -33,6 +33,8 @@ dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
 
+# Line coverage via the vendored PEP 669 tracer (tools/cbcov.py; this
+# environment ships no coverage.py/pytest-cov). Fails under 90%.
 coverage:
-	$(PYTHON) -m pytest tests/ -q --cov=cueball_tpu --cov-report=term 2>/dev/null || \
-	$(PYTHON) -m pytest tests/ -q
+	CBCOV=1 CBCOV_OUT=.cbcov_pct $(PYTHON) -m pytest tests/ -q
+	$(PYTHON) tools/cbcov.py check .cbcov_pct 90

@@ -31,6 +31,20 @@ except RuntimeError:  # pragma: no cover - backends already initialized
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Coverage measurement (CBCOV=1, `make coverage`): must start before
+# any cueball_tpu module is imported so import-time lines count.
+import pytest  # noqa: E402
+from tools import cbcov as _cbcov  # noqa: E402
+_CBCOV_ON = _cbcov.maybe_start()
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_sessionfinish(session, exitstatus):
+    # trylast + never raises: pytest's own summary and the other
+    # sessionfinish finalizers must still run (see tools/cbcov.py).
+    if _CBCOV_ON:
+        _cbcov.report()
+
 
 def run_async(coro, timeout=30.0):
     """Run a test coroutine with a hard timeout."""

@@ -24,6 +24,11 @@ FLEET_DRIVER = '''
 import asyncio, os, sys
 sys.path.insert(0, %(root)r)
 sys.path.insert(0, os.path.join(%(root)r, "examples"))
+# Hermetic like tests/conftest.py: the container sitecustomize registers
+# the TPU backend at startup regardless of JAX_PLATFORMS, and a slow or
+# wedged chip tunnel would hang this subprocess; pin CPU via jax.config.
+import jax
+jax.config.update("jax_platforms", "cpu")
 import inference_fleet_client as ex
 
 async def serve(name, reader, writer):

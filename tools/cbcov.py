@@ -1,0 +1,194 @@
+"""cbcov: vendored line-coverage measurement for the test suite.
+
+The reference's `make coverage` runs istanbul/nyc over its suite
+(reference Makefile:59-61); this environment ships neither coverage.py
+nor pytest-cov and installing packages is off-limits, so — like the
+vendored lint gate (tools/cblint.py) — coverage is measured with the
+stdlib only.
+
+Implementation: PEP 669 (`sys.monitoring`, Python >= 3.12) LINE events,
+registered on the COVERAGE_ID tool slot. Each (code object, line)
+location fires once and is then disabled by returning
+`sys.monitoring.DISABLE`, so steady-state overhead is near zero — the
+suite runs at full speed, unlike settrace-based tracers.
+
+The denominator (executable lines per file) comes from compiling each
+source file and walking its code objects' `co_lines()` tables — the
+same statement universe coverage.py uses. Lines marked
+`# pragma: no cover` (and any `def`/`class` body they open) are
+excluded.
+
+Wire-up: tests/conftest.py calls `maybe_start()` at import (before any
+cueball_tpu module loads) and `report()` from pytest_sessionfinish
+(trylast, after the terminal summary — and it must not raise there, or
+it would suppress pytest's own summary and remaining finalizers).
+Fail-under is therefore enforced as a separate step:
+
+    CBCOV=1                 enable measurement
+    CBCOV_TARGET=path       directory to measure (default: cueball_tpu)
+    CBCOV_OUT=file          also write the total percent to this file
+    python tools/cbcov.py check <file> <min_pct>   # gate, exits 2
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_HITS: dict[str, set[int]] = {}
+_TARGET: str | None = None
+_ACTIVE = False
+
+
+def _on_line(code, lineno):
+    fname = code.co_filename
+    if fname.startswith(_TARGET):
+        _HITS.setdefault(fname, set()).add(lineno)
+    # DISABLE is per-(code, line) location: this exact line stops
+    # reporting, every other line still fires its own first hit.
+    return sys.monitoring.DISABLE
+
+
+def start(target_dir: str) -> None:
+    global _TARGET, _ACTIVE
+    mon = sys.monitoring
+    _TARGET = os.path.abspath(target_dir) + os.sep
+    mon.use_tool_id(mon.COVERAGE_ID, 'cbcov')
+    mon.register_callback(mon.COVERAGE_ID, mon.events.LINE, _on_line)
+    mon.set_events(mon.COVERAGE_ID, mon.events.LINE)
+    _ACTIVE = True
+
+
+def maybe_start() -> bool:
+    """Start measurement when CBCOV=1; called from conftest import."""
+    if os.environ.get('CBCOV', '') in ('', '0'):
+        return False
+    start(os.environ.get('CBCOV_TARGET', 'cueball_tpu'))
+    return True
+
+
+def _excluded_lines(source: str) -> set[int]:
+    """Lines tagged `# pragma: no cover`, plus — when such a line opens
+    a block (def/class/if) — every line of that block."""
+    out: set[int] = set()
+    lines = source.split('\n')
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if 'pragma: no cover' in line:
+            out.add(i + 1)
+            indent = len(line) - len(line.lstrip())
+            code_part = line.split('#', 1)[0]
+            if code_part.rstrip().endswith(':'):
+                j = i + 1
+                while j < len(lines):
+                    nxt = lines[j]
+                    if nxt.strip() and \
+                            len(nxt) - len(nxt.lstrip()) <= indent:
+                        break
+                    out.add(j + 1)
+                    j += 1
+                i = j
+                continue
+        i += 1
+    return out
+
+
+def _executable_lines(path: str) -> set[int]:
+    with open(path, encoding='utf-8') as f:
+        source = f.read()
+    code = compile(source, path, 'exec')
+    lines: set[int] = set()
+    stack = [code]
+    while stack:
+        co = stack.pop()
+        for const in co.co_consts:
+            if hasattr(const, 'co_lines'):
+                stack.append(const)
+        for _, _, lineno in co.co_lines():
+            if lineno is not None and lineno > 0:
+                lines.add(lineno)
+    # A module's code object reports line 0/1 for the implicit
+    # docstring/RESUME; keep only lines that hold real source.
+    src_lines = source.split('\n')
+    lines = {l for l in lines
+             if l <= len(src_lines) and src_lines[l - 1].strip()}
+    return lines - _excluded_lines(source)
+
+
+def report(stream=None) -> float:
+    """Print the per-file coverage table; return total percent."""
+    if not _ACTIVE:
+        return -1.0
+    stream = stream or sys.stdout
+    files = []
+    for root, dirs, names in os.walk(_TARGET.rstrip(os.sep)):
+        dirs[:] = [d for d in dirs if d != '__pycache__']
+        files.extend(os.path.join(root, n) for n in names
+                     if n.endswith('.py'))
+    rows = []
+    tot_stmts = tot_miss = 0
+    for path in sorted(files):
+        stmts = _executable_lines(path)
+        hit = _HITS.get(os.path.abspath(path), set())
+        missed = stmts - hit
+        tot_stmts += len(stmts)
+        tot_miss += len(missed)
+        pct = 100.0 * (1 - len(missed) / len(stmts)) if stmts else 100.0
+        rows.append((os.path.relpath(path), len(stmts), len(missed),
+                     pct, _ranges(missed)))
+    total_pct = 100.0 * (1 - tot_miss / tot_stmts) if tot_stmts else 100.0
+
+    w = max(len(r[0]) for r in rows) if rows else 10
+    stream.write('\n%-*s %7s %6s %6s  %s\n' % (
+        w, 'Name', 'Stmts', 'Miss', 'Cover', 'Missing'))
+    stream.write('-' * (w + 40) + '\n')
+    for name, stmts, miss, pct, missing in rows:
+        stream.write('%-*s %7d %6d %5.0f%%  %s\n' % (
+            w, name, stmts, miss, pct, missing))
+    stream.write('-' * (w + 40) + '\n')
+    stream.write('%-*s %7d %6d %5.1f%%\n' % (
+        w, 'TOTAL', tot_stmts, tot_miss, total_pct))
+
+    out_file = os.environ.get('CBCOV_OUT')
+    if out_file:
+        with open(out_file, 'w', encoding='utf-8') as f:
+            f.write('%.2f\n' % total_pct)
+    return total_pct
+
+
+def _ranges(missed: set[int], limit: int = 12) -> str:
+    if not missed:
+        return ''
+    runs = []
+    ordered = sorted(missed)
+    lo = prev = ordered[0]
+    for n in ordered[1:]:
+        if n == prev + 1:
+            prev = n
+            continue
+        runs.append('%d' % lo if lo == prev else '%d-%d' % (lo, prev))
+        lo = prev = n
+    runs.append('%d' % lo if lo == prev else '%d-%d' % (lo, prev))
+    if len(runs) > limit:
+        runs = runs[:limit] + ['...']
+    return ','.join(runs)
+
+
+def main(argv) -> int:
+    if len(argv) == 4 and argv[1] == 'check':
+        with open(argv[2], encoding='utf-8') as f:
+            pct = float(f.read().strip())
+        if pct < float(argv[3]):
+            sys.stderr.write('cbcov: FAIL total coverage %.1f%% < %s%%\n'
+                             % (pct, argv[3]))
+            return 2
+        sys.stdout.write('cbcov: total coverage %.1f%% >= %s%%\n'
+                         % (pct, argv[3]))
+        return 0
+    sys.stderr.write('usage: cbcov.py check <pct-file> <min-pct>\n')
+    return 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main(sys.argv))
