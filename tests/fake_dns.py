@@ -21,6 +21,8 @@ from cueball_tpu.dns_client import DnsError, DnsMessage, DnsTimeoutError
 class Cfg:
     use_a2 = False
     srv_ttl = 3600
+    # *.flaky: remaining scripted SERVFAILs per qtype before success.
+    flaky_fails = {}
 
 
 def _rr(name, rtype, ttl, target, port=None):
@@ -103,6 +105,36 @@ class FakeDnsClient:
                 answers.append(_rr(domain, 'A', 3600, '1.2.3.9'))
             else:
                 authority.append(_rr(domain, 'SOA', 17, None))
+        elif tld == 'flaky':
+            # Transient SERVFAILs: Cfg.flaky_fails[qtype] failures, then
+            # answers — drives the aaaa_error/a_error retry ladders.
+            if len(parts) > 2 and parts[1] == 'srv' and \
+                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+                answers.append(_rr(domain, 'SRV', Cfg.srv_ttl,
+                                   'host.flaky', 113))
+            elif parts[1] == 'host' and \
+                    Cfg.flaky_fails.get(qtype, 0) > 0:
+                Cfg.flaky_fails[qtype] -= 1
+                err = DnsError('SERVFAIL', domain)
+            elif parts[1] == 'host' and qtype == 'AAAA':
+                answers.append(_rr(domain, 'AAAA', 3600, 'fd00::5'))
+            elif parts[1] == 'host' and qtype == 'A':
+                answers.append(_rr(domain, 'A', 3600, '1.2.3.7'))
+            else:
+                err = DnsError('NXDOMAIN', domain)
+        elif tld == 'refused':
+            # AAAA lookups REFUSED (fast-fail, no retry ladder); SRV and
+            # A behave normally.
+            if len(parts) > 2 and parts[1] == 'srv' and \
+                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+                answers.append(_rr(domain, 'SRV', Cfg.srv_ttl,
+                                   'host.refused', 114))
+            elif parts[1] == 'host' and qtype == 'AAAA':
+                err = DnsError('REFUSED', domain)
+            elif parts[1] == 'host' and qtype == 'A':
+                answers.append(_rr(domain, 'A', 3600, '1.2.3.8'))
+            else:
+                err = DnsError('NXDOMAIN', domain)
         elif tld == 'timeout':
             loop.call_later(opts['timeout'] / 1000.0, cb,
                             DnsTimeoutError(domain), None)

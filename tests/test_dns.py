@@ -24,6 +24,7 @@ def fake_v6(monkeypatch):
     FakeDnsClient.instances = []
     Cfg.use_a2 = False
     Cfg.srv_ttl = 3600
+    Cfg.flaky_fails = {}
     yield
 
 
@@ -322,4 +323,112 @@ def test_srv_only_services_expire():
         assert 'a.ok/A' not in h and 'aaaa.ok/AAAA' not in h
         res.stop()
         await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_aaaa_error_retry_ladder():
+    """Transient SERVFAILs on AAAA walk the aaaa_try->aaaa_error retry
+    ladder (doubling delay) until success (dns_resolver.py
+    state_aaaa_error; reference lib/resolver.js:852-886)."""
+    async def t():
+        Cfg.flaky_fails = {'AAAA': 2}
+        res, client = make_res('srv.flaky')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+
+        h = history(client)
+        # 3 AAAA attempts (2 scripted failures + 1 success), 1 A.
+        assert h.count('host.flaky/AAAA') == 3
+        assert h.count('host.flaky/A') == 1
+        assert 'fd00::5' in [b['address'] for b in backends]
+        assert '1.2.3.7' in [b['address'] for b in backends]
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_a_error_retries_exhausted_keeps_v6():
+    """A lookups that keep SERVFAILing exhaust the a_error ladder; the
+    resolver still comes up with the v6 addresses it has and records
+    the v4 failure in getLastError() (dns_resolver.py state_a_error)."""
+    async def t():
+        Cfg.flaky_fails = {'A': 99}
+        res, client = make_res('srv.flaky')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+
+        h = history(client)
+        assert h.count('host.flaky/A') == 3      # retries exhausted
+        addrs = [b['address'] for b in backends]
+        assert addrs == ['fd00::5']              # v6-only survives
+        # The wrapper saw a successful update (so its own last error is
+        # clear); the inner machine keeps the v4 failure for kang.
+        assert 'IPv4' in str(res.r_fsm.r_last_error)
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_aaaa_refused_fast_fails_to_a():
+    """REFUSED on AAAA zeroes the retry budget: exactly one AAAA query,
+    then straight to the A section (dns_resolver.py state_aaaa_try
+    REFUSED branch; reference lib/resolver.js:861-865)."""
+    async def t():
+        res, client = make_res('srv.refused')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+
+        h = history(client)
+        assert h.count('host.refused/AAAA') == 1
+        assert [b['address'] for b in backends] == ['1.2.3.8']
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_bootstrap_teardown_refcounting():
+    """Two resolvers share one refcounted bootstrap; each stop
+    decrements, and the bootstrap itself is stopped only when the last
+    user goes away (dns_resolver.py state_init/state_check_ns;
+    reference lib/resolver.js:479-508)."""
+    async def t():
+        from cueball_tpu.dns_resolver import DNSResolverFSM
+        DNSResolverFSM.bootstrap_resolvers = {}
+        client = FakeDnsClient()
+
+        def mk():
+            return DNSResolver({
+                'domain': 'a.ok', 'service': '_foo._tcp',
+                'defaultPort': 112, 'resolvers': ['srv.ok'],
+                'recovery': RECOVERY, 'dnsClient': client,
+            })
+
+        r1, r2 = mk(), mk()
+        r1.start()
+        await wait_for_state(r1, 'running', timeout=10)
+        r2.start()
+        await wait_for_state(r2, 'running', timeout=10)
+
+        boot1 = r1.r_fsm.r_bootstrap
+        boot2 = r2.r_fsm.r_bootstrap
+        assert boot1 is boot2, 'bootstrap must be shared by name'
+        assert boot1.r_ref_count == 2
+        assert len(DNSResolverFSM.bootstrap_resolvers) == 1
+
+        r1.stop()
+        await wait_for_state(r1, 'stopped')
+        assert boot1.r_ref_count == 1
+        assert not boot1.is_in_state('init'), \
+            'bootstrap must stay up while still referenced'
+
+        r2.stop()
+        await wait_for_state(r2, 'stopped')
+        assert boot1.r_ref_count == 0
+        await wait_for_state(boot1, 'init', timeout=5)
     run_async(t())
