@@ -1,11 +1,17 @@
 """Kang-style debug HTTP server.
 
-The reference exposes pool-monitor snapshots over Joyent's kang protocol,
-with the HTTP server supplied by the consumer (kang is a devDependency;
-reference lib/pool-monitor.js:60-216, test/monitor.test.js). Here the
-framework ships its own minimal asyncio HTTP endpoint:
+The reference exposes pool-monitor snapshots over Joyent's kang
+protocol, with the HTTP server supplied by the consumer (kang is a
+devDependency; reference lib/pool-monitor.js:60-216,
+test/monitor.test.js). Here the framework ships its own asyncio HTTP
+endpoint: persistent HTTP/1.1 connections (Connection: close and
+HTTP/1.0 honored), strict request-line/header parsing (400 on
+malformed, 405 on non-GET), and the kang service-ident handshake —
+/kang/snapshot leads with the `service` block (name, component, ident,
+version, pid) that kang aggregators use to identify an agent, built
+from PoolMonitor.to_kang_options().
 
-    GET /kang/snapshot          - full snapshot of all registered objects
+    GET /kang/snapshot          - service ident + all registered objects
     GET /kang/types             - ['pool', 'set', 'dns_res']
     GET /kang/objects/<type>    - ids of registered objects of a type
     GET /kang/obj/<type>/<id>   - one object's snapshot
@@ -17,61 +23,158 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 
 from .monitor import pool_monitor
+
+_MAX_HEADERS = 64
+_MAX_LINE = 8192
+
+_REASONS = {200: b'OK', 400: b'Bad Request', 404: b'Not Found',
+            405: b'Method Not Allowed'}
 
 
 def _json_default(o):
     return repr(o)
 
 
-async def _serve_client(reader, writer, collector=None):
+def _kang_snapshot() -> dict:
+    """The kang agent handshake: service ident first, then stats and
+    the per-type object listings (kang snapshot shape; reference
+    lib/pool-monitor.js:60-79 toKangOptions feeds the same fields to
+    the kang server)."""
+    opts = pool_monitor.to_kang_options()
+    snap = {
+        'service': {
+            'name': opts['service_name'],
+            'component': 'cueball_tpu',
+            'ident': opts['ident'],
+            'version': opts['version'],
+            'pid': os.getpid(),
+        },
+        'stats': opts['stats'](),
+    }
+    snap.update(pool_monitor.snapshot())
+    return snap
+
+
+async def _read_request(reader):
+    """Parse one request. Returns (method, path, keep_alive) or a
+    status int on protocol error, or None on clean EOF."""
     try:
         line = await reader.readline()
-        if not line:
-            return
-        parts = line.decode('latin-1').split(' ')
-        if len(parts) < 2:
-            return
-        method, path = parts[0], parts[1]
-        while True:
-            h = await reader.readline()
-            if h in (b'\r\n', b'\n', b''):
-                break
+    except ValueError:       # line exceeded the stream's 64 KiB limit
+        return 400
+    if not line:
+        return None
+    if len(line) > _MAX_LINE:
+        return 400
+    parts = line.decode('latin-1').rstrip('\r\n').split(' ')
+    if len(parts) != 3 or not parts[1].startswith('/'):
+        return 400
+    method, path, version = parts
+    if version not in ('HTTP/1.1', 'HTTP/1.0'):
+        return 400
 
-        status = 200
-        ctype = 'application/json'
+    headers = {}
+    for _ in range(_MAX_HEADERS):
         try:
-            if path == '/kang/snapshot':
-                body = json.dumps(pool_monitor.snapshot(),
-                                  default=_json_default).encode()
-            elif path == '/kang/types':
-                body = json.dumps(pool_monitor.list_types()).encode()
-            elif path.startswith('/kang/objects/'):
-                t = path.split('/')[3]
-                body = json.dumps(pool_monitor.list_objects(t)).encode()
-            elif path.startswith('/kang/obj/'):
-                _, _, _, t, id_ = path.split('/', 4)
-                body = json.dumps(pool_monitor.get(t, id_),
-                                  default=_json_default).encode()
-            elif path == '/kang/fleet':
-                body = json.dumps(pool_monitor.fleet_snapshot(),
-                                  default=_json_default).encode()
-            elif path == '/metrics' and collector is not None:
-                body = collector.collect().encode()
-                ctype = 'text/plain; version=0.0.4'
-            else:
-                status, body = 404, b'{"error": "not found"}'
-        except (KeyError, ValueError, IndexError) as e:
-            status, body = 404, json.dumps(
-                {'error': str(e)}).encode()
+            h = await reader.readline()
+        except ValueError:
+            return 400
+        if h in (b'\r\n', b'\n'):
+            break
+        if h == b'' or len(h) > _MAX_LINE:
+            return 400
+        name, sep, value = h.decode('latin-1').partition(':')
+        if not sep:
+            return 400
+        headers[name.strip().lower()] = value.strip()
+    else:
+        return 400
 
-        writer.write(
-            b'HTTP/1.1 %d %s\r\nContent-Type: %s\r\n'
-            b'Content-Length: %d\r\nConnection: close\r\n\r\n' % (
-                status, b'OK' if status == 200 else b'Not Found',
-                ctype.encode(), len(body)) + body)
-        await writer.drain()
+    conn = headers.get('connection', '').lower()
+    if version == 'HTTP/1.0':
+        keep_alive = conn == 'keep-alive'
+    else:
+        keep_alive = conn != 'close'
+
+    # Drain any request body so keep-alive never parses body bytes as
+    # the next request line; chunked is not worth parsing on a debug
+    # port, so such connections simply close after the response.
+    if 'transfer-encoding' in headers:
+        keep_alive = False
+    else:
+        clen = headers.get('content-length')
+        if clen is not None:
+            try:
+                n = int(clen)
+            except ValueError:
+                return 400
+            if n < 0 or n > (1 << 20):
+                return 400
+            try:
+                await reader.readexactly(n)
+            except asyncio.IncompleteReadError:
+                return None
+    return method, path.partition('?')[0], keep_alive
+
+
+def _route(method: str, path: str, collector):
+    """Dispatch one request; returns (status, ctype, body)."""
+    if method != 'GET':
+        return 405, 'application/json', b'{"error": "GET only"}'
+    ctype = 'application/json'
+    try:
+        if path == '/kang/snapshot':
+            body = json.dumps(_kang_snapshot(),
+                              default=_json_default).encode()
+        elif path == '/kang/types':
+            body = json.dumps(pool_monitor.list_types()).encode()
+        elif path.startswith('/kang/objects/'):
+            t = path.split('/')[3]
+            body = json.dumps(pool_monitor.list_objects(t)).encode()
+        elif path.startswith('/kang/obj/'):
+            _, _, _, t, id_ = path.split('/', 4)
+            body = json.dumps(pool_monitor.get(t, id_),
+                              default=_json_default).encode()
+        elif path == '/kang/fleet':
+            body = json.dumps(pool_monitor.fleet_snapshot(),
+                              default=_json_default).encode()
+        elif path == '/metrics' and collector is not None:
+            body = collector.collect().encode()
+            ctype = 'text/plain; version=0.0.4'
+        else:
+            return 404, ctype, b'{"error": "not found"}'
+    except (KeyError, ValueError, IndexError) as e:
+        return 404, ctype, json.dumps({'error': str(e)}).encode()
+    return 200, ctype, body
+
+
+async def _serve_client(reader, writer, collector=None):
+    try:
+        while True:
+            req = await _read_request(reader)
+            if req is None:
+                return
+            if isinstance(req, int):        # protocol error
+                status, ctype, body = (req, 'application/json',
+                                       b'{"error": "bad request"}')
+                keep_alive = False
+            else:
+                method, path, keep_alive = req
+                status, ctype, body = _route(method, path, collector)
+            writer.write(
+                b'HTTP/1.1 %d %s\r\nContent-Type: %s\r\n'
+                b'Content-Length: %d\r\nConnection: %s\r\n\r\n' % (
+                    status, _REASONS.get(status, b'Error'),
+                    ctype.encode(), len(body),
+                    b'keep-alive' if keep_alive else b'close') + body)
+            await writer.drain()
+            if not keep_alive:
+                return
+    except ConnectionError:
+        pass
     finally:
         writer.close()
 

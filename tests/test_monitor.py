@@ -14,11 +14,7 @@ from test_pool import Ctx, make_pool
 from test_cset import make_cset
 
 
-async def _get(port, path):
-    reader, writer = await asyncio.open_connection('127.0.0.1', port)
-    writer.write(b'GET %s HTTP/1.1\r\nHost: x\r\n\r\n' %
-                 path.encode())
-    await writer.drain()
+async def _read_response(reader):
     status_line = await reader.readline()
     status = int(status_line.split()[1])
     headers = {}
@@ -29,6 +25,15 @@ async def _get(port, path):
         k, _, v = line.decode().partition(':')
         headers[k.strip().lower()] = v.strip()
     body = await reader.readexactly(int(headers['content-length']))
+    return status, headers, body
+
+
+async def _get(port, path):
+    reader, writer = await asyncio.open_connection('127.0.0.1', port)
+    writer.write(b'GET %s HTTP/1.1\r\nHost: x\r\n\r\n' %
+                 path.encode())
+    await writer.drain()
+    status, headers, body = await _read_response(reader)
     writer.close()
     return status, json.loads(body) if \
         headers.get('content-type', '').startswith('application/json') \
@@ -151,4 +156,131 @@ def test_dns_resolver_registered():
             await wait_for_state(res, 'stopped')
         finally:
             mod_dns.have_global_v6 = orig
+    run_async(t())
+
+
+async def _get_on(reader, writer, path, headers=b''):
+    writer.write(b'GET %s HTTP/1.1\r\nHost: x\r\n%s\r\n' %
+                 (path.encode(), headers))
+    await writer.drain()
+    return await _read_response(reader)
+
+
+def test_kang_service_ident_handshake():
+    """/kang/snapshot leads with the kang agent service block
+    (reference: toKangOptions feeds the same fields to the kang server,
+    lib/pool-monitor.js:60-79)."""
+    async def t():
+        import os
+        server = await serve_monitor()
+        port = server.sockets[0].getsockname()[1]
+        status, snap = await _get(port, '/kang/snapshot')
+        assert status == 200
+        svc = snap['service']
+        assert svc['name'] == 'cueball'
+        assert svc['component'] == 'cueball_tpu'
+        assert svc['version'] == '1.0.0'
+        assert svc['pid'] == os.getpid()
+        assert svc['ident']
+        assert 'stats' in snap and 'types' in snap
+        server.close()
+    run_async(t())
+
+
+def test_http_keepalive_and_errors():
+    """One connection serves many requests (HTTP/1.1 persistent);
+    Connection: close, bad requests, and non-GET are handled."""
+    async def t():
+        server = await serve_monitor()
+        port = server.sockets[0].getsockname()[1]
+
+        reader, writer = await asyncio.open_connection('127.0.0.1', port)
+        # Three sequential requests on the SAME connection.
+        for _ in range(3):
+            status, hdrs, body = await _get_on(reader, writer,
+                                               '/kang/types')
+            assert status == 200
+            assert hdrs['connection'] == 'keep-alive'
+            assert json.loads(body) == ['pool', 'set', 'dns_res']
+        # Query strings are stripped for routing.
+        status, hdrs, _ = await _get_on(reader, writer,
+                                        '/kang/types?x=1')
+        assert status == 200
+        # 405 on non-GET, still keeps the connection.
+        writer.write(b'POST /kang/types HTTP/1.1\r\nHost: x\r\n\r\n')
+        await writer.drain()
+        line = await reader.readline()
+        assert b'405' in line
+        while (await reader.readline()) not in (b'\r\n', b'\n', b''):
+            pass
+        await reader.readexactly(len(b'{"error": "GET only"}'))
+        # Connection: close is honored.
+        status, hdrs, _ = await _get_on(reader, writer, '/kang/types',
+                                        headers=b'Connection: close\r\n')
+        assert status == 200 and hdrs['connection'] == 'close'
+        assert await reader.read(1) == b''   # server closed
+        writer.close()
+
+        # Malformed request line -> 400, closed.
+        reader, writer = await asyncio.open_connection('127.0.0.1', port)
+        writer.write(b'NONSENSE\r\n\r\n')
+        await writer.drain()
+        line = await reader.readline()
+        assert b'400' in line
+        writer.close()
+
+        # HTTP/1.0 defaults to close.
+        reader, writer = await asyncio.open_connection('127.0.0.1', port)
+        writer.write(b'GET /kang/types HTTP/1.0\r\n\r\n')
+        await writer.drain()
+        line = await reader.readline()
+        assert b'200' in line
+        writer.close()
+
+        server.close()
+    run_async(t())
+
+
+def test_http_body_drain_and_oversize_line():
+    """A bodied non-GET must not desync keep-alive (its body is drained,
+    not parsed as the next request line), and a request line beyond the
+    stream limit answers 400 instead of crashing the handler."""
+    async def t():
+        server = await serve_monitor()
+        port = server.sockets[0].getsockname()[1]
+
+        # POST with a body, then a pipelined legitimate GET on the same
+        # connection: the GET must be answered 200, not parsed as
+        # 'helloGET ...'.
+        reader, writer = await asyncio.open_connection('127.0.0.1', port)
+        writer.write(b'POST /kang/types HTTP/1.1\r\nHost: x\r\n'
+                     b'Content-Length: 5\r\n\r\nhello'
+                     b'GET /kang/types HTTP/1.1\r\nHost: x\r\n\r\n')
+        await writer.drain()
+        status, _, _ = await _read_response(reader)
+        assert status == 405
+        status, _, body = await _read_response(reader)
+        assert status == 200
+        assert json.loads(body) == ['pool', 'set', 'dns_res']
+        writer.close()
+
+        # Oversized request line: 400, no unhandled ValueError.
+        reader, writer = await asyncio.open_connection('127.0.0.1', port)
+        writer.write(b'GET /' + b'a' * 70000 + b' HTTP/1.1\r\n\r\n')
+        await writer.drain()
+        line = await reader.readline()
+        assert b'400' in line
+        writer.close()
+
+        # Chunked request: answered, then the connection closes.
+        reader, writer = await asyncio.open_connection('127.0.0.1', port)
+        writer.write(b'GET /kang/types HTTP/1.1\r\nHost: x\r\n'
+                     b'Transfer-Encoding: chunked\r\n\r\n')
+        await writer.drain()
+        status, hdrs, _ = await _read_response(reader)
+        assert status == 200 and hdrs['connection'] == 'close'
+        assert await reader.read(1) == b''
+        writer.close()
+
+        server.close()
     run_async(t())

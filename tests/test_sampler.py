@@ -297,7 +297,8 @@ def test_sampler_start_stop_and_kang_surface():
             port = server.sockets[0].getsockname()[1]
             reader, writer = await asyncio.open_connection(
                 '127.0.0.1', port)
-            writer.write(b'GET /kang/fleet HTTP/1.1\r\n\r\n')
+            writer.write(b'GET /kang/fleet HTTP/1.1\r\n'
+                         b'Connection: close\r\n\r\n')
             body = (await reader.read()).split(b'\r\n\r\n', 1)[1]
             import json
             fleet = json.loads(body)
@@ -306,7 +307,8 @@ def test_sampler_start_stop_and_kang_surface():
 
             reader, writer = await asyncio.open_connection(
                 '127.0.0.1', port)
-            writer.write(b'GET /metrics HTTP/1.1\r\n\r\n')
+            writer.write(b'GET /metrics HTTP/1.1\r\n'
+                         b'Connection: close\r\n\r\n')
             text = (await reader.read()).decode()
             assert 'cueball_fleet_mean_load' in text
             assert 'cueball_fleet_n_pools' in text
