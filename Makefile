@@ -34,7 +34,12 @@ dryrun:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
 
 # Line coverage via the vendored PEP 669 tracer (tools/cbcov.py; this
-# environment ships no coverage.py/pytest-cov). Fails under 90%.
+# environment ships no coverage.py/pytest-cov). Runs the suite on both
+# cores (each shadows the other's Python lines), merges the hit sets,
+# and fails under 90%.
 coverage:
-	CBCOV=1 CBCOV_OUT=.cbcov_pct $(PYTHON) -m pytest tests/ -q
+	rm -f .cbcov_hits .cbcov_pct
+	CBCOV=1 CBCOV_MERGE=.cbcov_hits $(PYTHON) -m pytest tests/ -q
+	CBCOV=1 CBCOV_MERGE=.cbcov_hits CBCOV_OUT=.cbcov_pct \
+	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -q
 	$(PYTHON) tools/cbcov.py check .cbcov_pct 90

@@ -269,7 +269,10 @@ def make_shardmap_step(mesh: Mesh):
     Semantically identical to fleet_step; the multichip dryrun asserts
     so (a wrong collective here genuinely fails the allclose, unlike
     GSPMD annotations which XLA always resolves to correct programs)."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map              # jax >= 0.8
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
 
     pool = P('pools')
     window = P('pools', None)

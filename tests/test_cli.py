@@ -115,3 +115,82 @@ def test_timeout_flag_accepts_durations():
     bad = run_cli('-S', '-t', '5h', '127.0.0.1:8080')
     assert bad.returncode == 2
     assert 'invalid time interval' in bad.stderr
+
+
+# -- in-process drives (coverage-visible, unlike the subprocess runs) --
+
+def test_inprocess_static_mode(capsys):
+    from cueball_tpu import cli as mod_cli
+    rc = mod_cli.main(['-S', '127.0.0.1:8080', '10.0.0.5'])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '127.0.0.1' in out and '8080' in out
+    assert '10.0.0.5' in out and ' 80 ' in out  # default port 80
+
+
+def test_inprocess_dns_mode_with_ip_input(capsys):
+    # DNS mode fed an IP literal: config_for_ip_or_domain routes it to
+    # the static resolver (reference bin/cbresolve:120-135).
+    from cueball_tpu import cli as mod_cli
+    rc = mod_cli.main(['127.0.0.2:9090'])
+    assert rc == 0
+    assert '127.0.0.2' in capsys.readouterr().out
+
+
+def test_inprocess_kang_listener(capsys):
+    from cueball_tpu import cli as mod_cli
+    rc = mod_cli.main(['-S', '-k', '0', '127.0.0.1:8081'])
+    assert rc == 0
+    assert '127.0.0.1' in capsys.readouterr().out
+
+
+def test_inprocess_bad_port(capsys):
+    from cueball_tpu import cli as mod_cli
+    rc = mod_cli.main(['-S', '-p', '70000', '1.2.3.4'])
+    assert rc == 2
+    assert 'bad value' in capsys.readouterr().err
+
+
+def test_inprocess_dns_mode_single_name_only(capsys):
+    from cueball_tpu import cli as mod_cli
+    rc = mod_cli.main(['a.example.com', 'b.example.com'])
+    assert rc == 2
+    assert 'exactly one' in capsys.readouterr().err
+
+
+def test_inprocess_follow_mode_until_cancelled(capsys):
+    import asyncio
+    from cueball_tpu import cli as mod_cli
+    from conftest import run_async
+
+    async def t():
+        args = mod_cli._build_parser().parse_args(
+            ['-S', '-f', '127.0.0.1:8082'])
+        task = asyncio.create_task(mod_cli._amain(args))
+        await asyncio.sleep(0.3)
+        task.cancel()
+        rc = await task
+        assert rc == 0
+    run_async(t())
+    out = capsys.readouterr().out
+    assert 'added' in out and '127.0.0.1' in out
+
+
+def test_inprocess_static_rejects_domain(capsys):
+    import pytest
+    from cueball_tpu import cli as mod_cli
+    with pytest.raises(SystemExit, match='not an IP'):
+        mod_cli.main(['-S', 'foo.example.com'])
+
+
+def test_inprocess_dns_failure_prints_error(capsys, monkeypatch):
+    # Nameserver on a closed loopback port: every lookup errors, the
+    # resolver goes failed, and the CLI reports rc 1 with the error
+    # (DEBUG=1 prints the full traceback, reference bin/cbresolve:388).
+    from cueball_tpu import cli as mod_cli
+    monkeypatch.setenv('DEBUG', '1')
+    rc = mod_cli.main(['-t', '200', '-r', '127.0.0.1@9',
+                       '-s', '_x._tcp', 'down.example'])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert 'Error' in err or 'error' in err

@@ -171,3 +171,55 @@ def test_soak_live_toggle_under_claim_load():
         assert real >= 30 and fake >= 30
         pool.stop()
     run_async(t())
+
+
+def test_dump_covers_sets_and_resolvers():
+    async def t():
+        from test_cset import make_cset
+        from test_pool import Ctx
+        ctx = Ctx()
+        cset, inner, _resolver = make_cset(ctx, target=1, maximum=2)
+        inner.emit('added', 'b1', {'address': '10.0.0.9', 'port': 5})
+        await asyncio.sleep(0.05)
+        d = cb.DNSResolver({
+            'domain': 'dump.example', 'service': '_x._tcp',
+            'defaultPort': 1,
+            'recovery': {'default': {'timeout': 1000, 'retries': 1,
+                                     'delay': 50}}})
+        report = cb.dump_fsm_histories()
+        assert 'set ' in report and '(set)' in report
+        assert 'dns_res ' in report and 'dump.example' in report
+        cset.stop()
+        d.stop()
+    run_async(t())
+
+
+def test_emit_dump_inline_without_loop(caplog):
+    """Signal delivered to a process with no running asyncio loop:
+    the handler toggles and dumps inline."""
+    assert not mod_utils.stack_traces_enabled()
+    try:
+        with caplog.at_level(logging.WARNING, logger='cueball.debug'):
+            mod_debug._on_debug_signal(signal.SIGUSR2, None)
+        assert mod_utils.stack_traces_enabled()
+        assert any('debug signal' in r.getMessage()
+                   for r in caplog.records)
+    finally:
+        mod_utils.disable_stack_traces()
+
+
+def test_init_from_env_bad_signal_logs_and_continues(caplog):
+    with caplog.at_level(logging.WARNING, logger='cueball.debug'):
+        mod_debug.init_from_env({'CUEBALL_DEBUG_SIGNAL': 'USR9'})
+    assert any('not installed' in r.getMessage() for r in caplog.records)
+
+
+def test_fsm_line_survives_broken_objects():
+    class Broken:
+        def get_state(self):
+            raise RuntimeError('nope')
+
+        def get_history(self):
+            raise RuntimeError('nope')
+    line = mod_debug._fsm_line('x', Broken())
+    assert 'state=?' in line

@@ -1,0 +1,46 @@
+"""Error-class formatting + VError-style cause chains (reference
+lib/errors.js:9-123): messages must stay operator-greppable with pool
+uuid/domain and backend host:port embedded."""
+
+from cueball_tpu import errors as mod_errors
+
+
+class _FakePool:
+    p_uuid = 'abcd1234-5678-90ab-cdef-001122334455'
+    p_domain = 'svc.example.com'
+    p_dead = {'b1': True}
+    p_keys = ['b1', 'b2']
+
+
+BACKEND = {'key': 'b1', 'name': None, 'address': '10.0.0.7', 'port': 443}
+
+
+def test_cause_chain_and_full_message():
+    root = ValueError('root cause')
+    mid = mod_errors.ConnectionError(BACKEND, 'error', 'connect', root)
+    top = mod_errors.NoBackendsError(_FakePool(), mid)
+    assert top.cause() is mid
+    fm = top.full_message()
+    assert 'No backends available' in fm
+    assert 'emitted "error" during connect' in fm
+    assert 'root cause' in fm
+
+
+def test_no_cause_leaves_context_alone():
+    e = mod_errors.CueBallError('plain')
+    assert e.cause() is None
+    assert e.full_message() == 'plain'
+
+
+def test_message_formats():
+    p = _FakePool()
+    assert 'svc.example.com' in str(mod_errors.ClaimTimeoutError(p))
+    assert '1 of 2 declared dead' in str(mod_errors.PoolFailedError(p))
+    assert 'abcd1234 ' in str(mod_errors.PoolFailedError(p))
+    assert 'stopping' in str(mod_errors.PoolStoppingError(p))
+    assert 'order and number of arguments' in str(
+        mod_errors.ClaimHandleMisusedError())
+    assert '10.0.0.7:443' in str(
+        mod_errors.ConnectionTimeoutError(BACKEND))
+    assert '10.0.0.7:443' in str(
+        mod_errors.ConnectionClosedError(BACKEND))

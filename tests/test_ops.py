@@ -225,3 +225,42 @@ def test_fleet_step_reset_clears_row_state():
         reset=jnp.asarray(reset), now_ms=jnp.float32(200.0 * 141)))
     assert float(out['filtered'][0]) == pytest.approx(5.0, rel=1e-3)
     assert float(out['filtered'][1]) < 2.0
+
+
+def test_shardmap_fleet_step_on_mesh():
+    """The hand-written shard_map form (explicit psum/pmax collectives)
+    agrees with the GSPMD step on the 8-device mesh — the same law the
+    multichip dryrun enforces, as a suite-resident test."""
+    from jax.sharding import Mesh
+    from cueball_tpu.parallel import fleet_init, fleet_inputs
+    from cueball_tpu.parallel.telemetry import (
+        fleet_step, make_shardmap_step, shard_inputs, shard_state)
+
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, ('pools',))
+    n = 32
+    rng = np.random.default_rng(21)
+    inp = fleet_inputs(
+        n,
+        samples=jnp.asarray(rng.uniform(0, 6, size=n), jnp.float32),
+        sojourns=jnp.asarray(rng.uniform(0, 400, size=n), jnp.float32),
+        target_delay=jnp.full((n,), 250.0, jnp.float32),
+        spares=jnp.full((n,), 2.0, jnp.float32),
+        active=jnp.ones((n,), bool),
+        now_ms=jnp.float32(500.0))
+    state0 = fleet_init(n)
+
+    sm_step = make_shardmap_step(mesh)
+    s_sm, o_sm, f_sm = sm_step(shard_state(state0, mesh),
+                               shard_inputs(inp, mesh))
+    s_un, o_un, f_un = fleet_step(state0, inp)
+
+    np.testing.assert_allclose(np.asarray(s_sm.windows),
+                               np.asarray(s_un.windows), rtol=1e-5)
+    for k in o_un:
+        np.testing.assert_allclose(np.asarray(o_sm[k]),
+                                   np.asarray(o_un[k]), rtol=1e-4,
+                                   err_msg=k)
+    for k in f_un:
+        np.testing.assert_allclose(float(f_sm[k]), float(f_un[k]),
+                                   rtol=1e-4, err_msg=k)

@@ -117,10 +117,25 @@ def _executable_lines(path: str) -> set[int]:
 
 
 def report(stream=None) -> float:
-    """Print the per-file coverage table; return total percent."""
+    """Print the per-file coverage table; return total percent.
+
+    With CBCOV_MERGE=<file>, hits from a previous run are unioned in
+    and the union is written back — `make coverage` uses this to
+    combine the native-core and CUEBALL_NO_NATIVE=1 suite runs (each
+    shadows the other core's Python lines)."""
     if not _ACTIVE:
         return -1.0
     stream = stream or sys.stdout
+
+    merge_file = os.environ.get('CBCOV_MERGE')
+    if merge_file:
+        import json
+        if os.path.exists(merge_file):
+            with open(merge_file, encoding='utf-8') as f:
+                for fname, lns in json.load(f).items():
+                    _HITS.setdefault(fname, set()).update(lns)
+        with open(merge_file, 'w', encoding='utf-8') as f:
+            json.dump({k: sorted(v) for k, v in _HITS.items()}, f)
     files = []
     for root, dirs, names in os.walk(_TARGET.rstrip(os.sep)):
         dirs[:] = [d for d in dirs if d != '__pycache__']
