@@ -386,10 +386,11 @@ class CueBallClaimHandle(FSM):
             raise AssertionError('options.callback must be callable')
         self.ch_callback = callback
 
-        self.ch_log = mod_utils.make_child_logger(
-            options.get('log') or logging.getLogger(
-                'cueball.claimhandle'),
-            component='CueBallClaimHandle')
+        # Child logger built lazily: handles log only on unusual paths
+        # (leak check, double release), and building a LoggerAdapter on
+        # every claim costs ~5% of the claim/release hot path.
+        self._ch_log_parent = options.get('log')
+        self._ch_log = None
 
         self.ch_slot = None
         self.ch_waiter_node = None  # pool claim-queue node (O(1) unlink)
@@ -403,6 +404,15 @@ class CueBallClaimHandle(FSM):
         self.ch_started = mod_utils.current_millis()
 
         super().__init__('waiting')
+
+    @property
+    def ch_log(self):
+        if self._ch_log is None:
+            self._ch_log = mod_utils.make_child_logger(
+                self._ch_log_parent or logging.getLogger(
+                    'cueball.claimhandle'),
+                component='CueBallClaimHandle')
+        return self._ch_log
 
     # -- misuse traps ----------------------------------------------------
     # Users sometimes mix up the (handle, connection) callback argument
