@@ -447,3 +447,105 @@ def test_stop_racing_inflight_upgrade_does_not_hang():
             assert handle.is_in_state('closed')
         srv.close()
     run_async(t())
+
+
+def test_https_tls_options_client_cert_ciphers_noverify():
+    """TLS passthrough fields (reference PASS_FIELDS lib/agent.js:96-97):
+    client cert chain, cipher selection, rejectUnauthorized=False (no
+    ca needed), plus TCP keep-alive initial delay plumbing."""
+    async def t():
+        key, cert = _make_self_signed()
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        srv = await MiniHttpServer().start(ssl_ctx=ctx)
+
+        agent = HttpsAgent({
+            'defaultPort': srv.port, 'spares': 1, 'maximum': 2,
+            'recovery': RECOVERY,
+            'rejectUnauthorized': False,
+            'certfile': cert, 'keyfile': key,
+            'ciphers': 'ECDHE+AESGCM:ECDHE+CHACHA20',
+            'tcpKeepAliveInitialDelay': 5000,
+        })
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/opts'), 10)
+        assert r.status == 200
+        await agent.stop()
+        srv.close()
+    run_async(t())
+
+
+def test_chunked_response_with_trailers_and_eof_body():
+    """Chunked transfer decoding incl. trailers, 204-no-body, and
+    EOF-terminated bodies (responses without content-length force
+    connection close)."""
+    async def t():
+        async def handler(reader, writer):
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    if line in (b'\r\n', b'\n'):
+                        continue
+                    _, path, _ = line.decode().split(' ', 2)
+                    while (await reader.readline()) not in (b'\r\n',
+                                                            b'\n', b''):
+                        pass
+                    if path == '/chunked':
+                        writer.write(
+                            b'HTTP/1.1 200 OK\r\n'
+                            b'Transfer-Encoding: chunked\r\n\r\n'
+                            b'5\r\nhello\r\n6\r\n world\r\n'
+                            b'0\r\nX-Trailer: yes\r\n\r\n')
+                        await writer.drain()
+                    elif path == '/nobody':
+                        writer.write(b'HTTP/1.1 204 No Content\r\n\r\n')
+                        await writer.drain()
+                    elif path == '/eof':
+                        writer.write(b'HTTP/1.1 200 OK\r\n\r\n'
+                                     b'until-the-end')
+                        await writer.drain()
+                        writer.close()
+                        return
+            except ConnectionError:
+                pass
+            finally:
+                writer.close()
+
+        server = await asyncio.start_server(handler, '127.0.0.1', 0)
+        port = server.sockets[0].getsockname()[1]
+        agent = HttpAgent({'defaultPort': port, 'spares': 1,
+                           'maximum': 2, 'recovery': RECOVERY})
+
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/chunked'), 10)
+        assert r.status == 200 and r.body == b'hello world'
+        assert r.text() == 'hello world'
+
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/nobody'), 10)
+        assert r.status == 204 and r.body == b''
+
+        r = await asyncio.wait_for(
+            agent.request('GET', '127.0.0.1', '/eof'), 10)
+        assert r.status == 200 and r.body == b'until-the-end'
+
+        await agent.stop()
+        server.close()
+    run_async(t())
+
+
+def test_agent_ctor_validation():
+    """Constructor asserts mirror the reference's assert-plus checks
+    (lib/agent.js:30-60)."""
+    good = {'defaultPort': 80, 'spares': 1, 'maximum': 2,
+            'recovery': RECOVERY}
+    for bad in [
+        'not-a-dict',
+        {**good, 'defaultPort': 'eighty'},
+        {**good, 'spares': 'one'},
+        {k: v for k, v in good.items() if k != 'recovery'},
+    ]:
+        with pytest.raises(AssertionError):
+            HttpAgent(bad)

@@ -41,12 +41,27 @@ _ACTIVE = False
 
 
 def _on_line(code, lineno):
-    fname = code.co_filename
-    if fname.startswith(_TARGET):
-        _HITS.setdefault(fname, set()).add(lineno)
+    # _TARGET can revert to None during interpreter shutdown (module
+    # globals are cleared while weakref/atexit callbacks still run).
+    target = _TARGET
+    if target is not None and code.co_filename.startswith(target):
+        _HITS.setdefault(code.co_filename, set()).add(lineno)
     # DISABLE is per-(code, line) location: this exact line stops
     # reporting, every other line still fires its own first hit.
     return sys.monitoring.DISABLE
+
+
+def stop() -> None:
+    """Stop measuring (idempotent); called after report so no LINE
+    callbacks fire during interpreter teardown."""
+    global _ACTIVE
+    if not _ACTIVE:
+        return
+    mon = sys.monitoring
+    mon.set_events(mon.COVERAGE_ID, 0)
+    mon.register_callback(mon.COVERAGE_ID, mon.events.LINE, None)
+    mon.free_tool_id(mon.COVERAGE_ID)
+    _ACTIVE = False
 
 
 def start(target_dir: str) -> None:
@@ -125,6 +140,11 @@ def report(stream=None) -> float:
     shadows the other core's Python lines)."""
     if not _ACTIVE:
         return -1.0
+    # Measurement MUST end even if the merge/out-file I/O below raises
+    # (corrupt merge file, unwritable CBCOV_OUT): a still-registered
+    # LINE callback fires into cleared module globals at interpreter
+    # teardown.
+    stop()
     stream = stream or sys.stdout
 
     merge_file = os.environ.get('CBCOV_MERGE')
