@@ -208,3 +208,128 @@ def test_mismatched_qid_ignored():
         assert isinstance(err, dc.DnsTimeoutError)
         transport.close()
     run_async(t())
+
+
+def test_truncation_falls_back_to_tcp():
+    """A UDP answer with TC set makes the client re-ask over TCP
+    (mname-client behavior; RFC 1035 4.2.2 framing)."""
+    async def t():
+        loop = asyncio.get_running_loop()
+
+        class TruncatingNS(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                qid = struct.unpack('>H', data[:2])[0]
+                # Empty TC response: QR|TC|RD|RA, no answers.
+                pkt = struct.pack('>HHHHHH', qid, 0x8380, 1, 0, 0, 0)
+                name, off = dc._decode_name(data, 12)
+                pkt += data[12:off + 4]
+                self.transport.sendto(pkt, addr)
+
+        async def tcp_ns(reader, writer):
+            ln = struct.unpack('>H', await reader.readexactly(2))[0]
+            data = await reader.readexactly(ln)
+            qid = struct.unpack('>H', data[:2])[0]
+            name, off = dc._decode_name(data, 12)
+            question = data[12:off + 4]
+            rrs = [(name, dc.TYPE_A, 300, bytes([10, 9, 8, 7]))]
+            payload = _answer_packet(qid, question, rrs)
+            writer.write(struct.pack('>H', len(payload)) + payload)
+            await writer.drain()
+            writer.close()
+
+        tcp_server = await asyncio.start_server(tcp_ns, '127.0.0.1', 0)
+        port = tcp_server.sockets[0].getsockname()[1]
+        transport, _ = await loop.create_datagram_endpoint(
+            TruncatingNS, local_addr=('127.0.0.1', port))
+
+        client = dc.DnsClient()
+        fut = loop.create_future()
+        client.lookup({'domain': 'big.example', 'type': 'A',
+                       'timeout': 3000,
+                       'resolvers': ['127.0.0.1@%d' % port]},
+                      lambda err, msg: fut.set_result((err, msg)))
+        err, msg = await asyncio.wait_for(fut, 5)
+        assert err is None
+        ans = msg.get_answers()
+        assert ans[0]['target'] == '10.9.8.7'
+        transport.close()
+        tcp_server.close()
+    run_async(t())
+
+
+def test_decode_aaaa_cname_soa_and_compression():
+    """Record decoding: AAAA, CNAME via compression pointer, SOA
+    minimum; compression loops must raise, not spin."""
+    q = dc.build_query(7, 'x.example', 'AAAA')
+    name_off = 12  # question name starts right after the header
+
+    # AAAA
+    rdata = bytes(range(16))
+    pkt = _answer_packet(7, q[12:], [('x.example', dc.TYPE_AAAA, 60,
+                                      rdata)])
+    msg = dc.parse_response(pkt)
+    assert msg.get_answers()[0]['target'] == \
+        '1:203:405:607:809:a0b:c0d:e0f'
+
+    # CNAME whose target is a compression pointer to the question name.
+    ptr = struct.pack('>H', 0xC000 | name_off)
+    pkt = _answer_packet(7, q[12:], [('x.example', dc.TYPE_CNAME, 60,
+                                      ptr)])
+    msg = dc.parse_response(pkt)
+    assert msg.get_answers()[0]['target'] == 'x.example'
+
+    # SOA: mname + rname + 5 counters; 'minimum' is the negative ttl.
+    rdata = dc.encode_name('ns1.example') + dc.encode_name(
+        'admin.example') + struct.pack('>IIIII', 1, 2, 3, 4, 17)
+    pkt = _answer_packet(7, q[12:], [('x.example', dc.TYPE_SOA, 60,
+                                      rdata)])
+    msg = dc.parse_response(pkt)
+    assert msg.get_answers()[0]['minimum'] == 17
+
+    # A self-referential pointer is a hard parse error.
+    import pytest
+    loop_name = struct.pack('>H', 0xC000 | 12)
+    bad = struct.pack('>HHHHHH', 7, 0x8180, 1, 0, 0, 0) + loop_name + \
+        struct.pack('>HH', dc.TYPE_A, dc.CLASS_IN)
+    with pytest.raises(ValueError, match='compression loop'):
+        dc._decode_name(bad, 12)
+
+
+def test_multi_error_and_empty_resolvers():
+    async def t():
+        loop = asyncio.get_running_loop()
+        client = dc.DnsClient()
+
+        # No resolvers at all: immediate MultiError(SERVFAIL).
+        fut = loop.create_future()
+        client.lookup({'domain': 'x.example', 'type': 'A',
+                       'timeout': 500, 'resolvers': []},
+                      lambda err, msg: fut.set_result(err))
+        err = await asyncio.wait_for(fut, 5)
+        assert getattr(err, 'name', None) == 'MultiError'
+        assert len(err.errors()) == 1
+        assert 'all resolvers failed' in str(err)
+
+        # Two dead resolvers: both errors collected into the MultiError.
+        fut = loop.create_future()
+        client.lookup({'domain': 'x.example', 'type': 'A',
+                       'timeout': 400,
+                       'resolvers': ['127.0.0.1@1', '127.0.0.2@1']},
+                      lambda err, msg: fut.set_result(err))
+        err = await asyncio.wait_for(fut, 5)
+        assert getattr(err, 'name', None) == 'MultiError'
+        assert len(err.errors()) == 2
+    run_async(t())
+
+
+def test_idna_label_encoding():
+    # Non-ASCII labels are IDNA-encoded; >63-octet labels are rejected.
+    out = dc.encode_name('bücher.example')
+    assert out.startswith(bytes([len(b'xn--bcher-kva')]) +
+                          b'xn--bcher-kva')
+    import pytest
+    with pytest.raises(ValueError, match='label too long'):
+        dc.encode_name('a' * 64 + '.example')
