@@ -135,6 +135,23 @@ class FakeDnsClient:
                 answers.append(_rr(domain, 'A', 3600, '1.2.3.8'))
             else:
                 err = DnsError('NXDOMAIN', domain)
+        elif tld == 'addl':
+            # SRV answers carrying A+AAAA additionals for their target:
+            # the resolver must use them and skip the address lookups
+            # entirely (reference lib/resolver.js:1318-1343).
+            if len(parts) > 2 and parts[1] == 'srv' and \
+                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+                answers.append(_rr(domain, 'SRV', Cfg.srv_ttl,
+                                   'host.addl', 115))
+                additionals = [
+                    _rr('host.addl', 'A', 3600, '1.2.3.11'),
+                    _rr('host.addl', 'AAAA', 3600, 'fd00::11'),
+                ]
+                msg = DnsMessage(1234, 'NOERROR', False, answers, [],
+                                 additionals)
+                loop.call_soon(cb, None, msg)
+                return
+            err = DnsError('NXDOMAIN', domain)
         elif tld == 'timeout':
             loop.call_later(opts['timeout'] / 1000.0, cb,
                             DnsTimeoutError(domain), None)

@@ -432,3 +432,97 @@ def test_bootstrap_teardown_refcounting():
         assert boot1.r_ref_count == 0
         await wait_for_state(boot1, 'init', timeout=5)
     run_async(t())
+
+
+def test_srv_additionals_skip_address_lookups():
+    """A/AAAA records in the SRV response's Additional section are used
+    directly: no follow-up address queries at all, and both families
+    surface as backends (dns_resolver.py aaaa_try/a_try additionals
+    shortcut; reference lib/resolver.js:832-851,1318-1343)."""
+    async def t():
+        res, client = make_res('srv.addl')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+
+        assert history(client) == ['_foo._tcp.srv.addl/SRV']
+        addrs = sorted(b['address'] for b in backends)
+        assert addrs == ['1.2.3.11', 'fd00::11']
+        assert all(b['port'] == 115 for b in backends)
+        assert res.r_fsm.r_counters.get('additionals-used', 0) >= 1
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+def test_multierror_rcode_voting():
+    """When every nameserver fails, the surviving rcodes vote and the
+    winner becomes the MultiError's code; timeouts are tallied but get
+    no vote (dns_resolver.py resolve(); reference
+    lib/resolver.js:1227-1259)."""
+    async def t():
+        from cueball_tpu.dns_client import (DnsError, DnsTimeoutError,
+                                            MultiError)
+
+        class VotingClient:
+            def lookup(self, opts, cb):
+                err = MultiError([
+                    DnsError('REFUSED', opts['domain'], '1.1.1.1'),
+                    DnsError('REFUSED', opts['domain'], '2.2.2.2'),
+                    DnsError('SERVFAIL', opts['domain'], '3.3.3.3'),
+                    DnsTimeoutError(opts['domain'], '4.4.4.4'),
+                ])
+                asyncio.get_running_loop().call_soon(cb, err, None)
+
+        res, _ = make_res('whatever.ok', dnsClient=VotingClient())
+        inner = res.r_fsm
+        req = inner.resolve('x.example', 'A', 1000)
+        got = []
+        req.on('error', lambda err: got.append(err))
+        req.send()
+        await asyncio.sleep(0.05)
+        assert len(got) == 1
+        assert got[0].code == 'REFUSED'
+        assert inner.r_counters.get('timeout') == 1
+        assert inner.r_counters.get('rcode-servfail') == 1
+        # 2 votes + 1 final-error tally.
+        assert inner.r_counters.get('rcode-refused') == 3
+    run_async(t())
+
+
+def test_cname_answers_are_skipped():
+    """CNAME records mixed into an A answer set are skipped (counted,
+    not treated as addresses); remaining A records still serve
+    (reference lib/resolver.js:1288-1300)."""
+    async def t():
+        from cueball_tpu.dns_client import DnsMessage
+
+        class CnameClient:
+            def lookup(self, opts, cb):
+                if opts['type'] == 'A':
+                    answers = [
+                        {'name': opts['domain'], 'type': 'CNAME',
+                         'ttl': 60, 'target': 'real.example',
+                         'port': None},
+                        {'name': 'real.example', 'type': 'A',
+                         'ttl': 60, 'target': '9.9.9.9', 'port': None},
+                    ]
+                    msg = DnsMessage(1, 'NOERROR', False, answers,
+                                     [], [])
+                else:
+                    msg = DnsMessage(1, 'NOERROR', False, [], [], [])
+                asyncio.get_running_loop().call_soon(cb, None, msg)
+
+        res, _ = make_res('whatever.ok', dnsClient=CnameClient())
+        inner = res.r_fsm
+        req = inner.resolve('x.example', 'A', 1000)
+        got = []
+        req.on('answers', lambda ans, ttl: got.append((ans, ttl)))
+        req.send()
+        await asyncio.sleep(0.05)
+        assert len(got) == 1
+        ans, ttl = got[0]
+        assert ans == [{'name': 'real.example', 'address': '9.9.9.9'}]
+        assert inner.r_counters.get('cname') == 1
+    run_async(t())

@@ -593,3 +593,98 @@ def test_pool_failure_retry_race():
         pool.stop()
         await wait_for_state(pool, 'stopped')
     run_async(t())
+
+
+class FailingInner(DummyInner):
+    """Inner resolver whose start() immediately reports failure."""
+
+    def start(self):
+        self.state = 'failed'
+        self.emit('updated', RuntimeError('no nameservers reachable'))
+
+
+def test_pool_with_prefailed_resolver_starts_failed():
+    """A pre-provided resolver already in 'failed' puts the pool
+    straight into 'failed'; claims fail fast with PoolFailedError
+    carrying the resolver's error as cause (pool.py state_starting;
+    reference lib/pool.js:333-352)."""
+    async def t():
+        ctx = Ctx()
+        inner = FailingInner()
+        resolver = ResolverFSM(inner, {})
+        resolver.start()
+        await wait_for_state(resolver, 'failed')
+
+        pool = ConnectionPool({
+            'domain': 'foobar', 'spares': 1, 'maximum': 2,
+            'constructor': lambda b: DummyConnection(ctx, b),
+            'recovery': {'default': {'timeout': 100, 'retries': 1,
+                                     'delay': 10}},
+            'resolver': resolver,
+        })
+        await wait_for_state(pool, 'failed')
+
+        with pytest.raises(mod_errors.PoolFailedError) as ei:
+            await pool.claim()
+        assert 'no nameservers reachable' in ei.value.full_message()
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
+
+
+def test_claim_on_stopped_pool_fails_fast():
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=1)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await wait_for_state(pool, 'running')
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+        with pytest.raises(mod_errors.PoolStoppingError):
+            await pool.claim()
+    run_async(t())
+
+
+def test_print_connections_summary(capsys):
+    """printConnections() operator helper (reference
+    lib/pool.js:812-832): per-backend state counts + dead map."""
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await wait_for_state(pool, 'running')
+        await settle()
+        obj = pool.print_connections()
+        assert obj['connections']['b1'].get('idle', 0) >= 1
+        assert obj['dead'] == {}
+        out = capsys.readouterr().out
+        assert 'live:' in out and 'dead:' in out
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
+
+
+def test_claim_task_cancellation_cancels_waiter():
+    """Cancelling the awaiting task maps onto waiter.cancel()
+    (pool.py claim; the reference callback-contract equivalent)."""
+    async def t():
+        ctx = Ctx()
+        # No backends ever appear: the claim queues forever.
+        pool, inner = make_pool(ctx, spares=1, maximum=1)
+        await settle()
+        task = asyncio.ensure_future(pool.claim())
+        await asyncio.sleep(0.05)
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        await settle()
+        assert len(pool.p_waiters) == 0, 'cancelled claim left a waiter'
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
