@@ -23,6 +23,9 @@ class Cfg:
     srv_ttl = 3600
     # *.flaky: remaining scripted SERVFAILs per qtype before success.
     flaky_fails = {}
+    # When True, every SRV query under *.ok fails with SERVFAIL
+    # (simulates a zone losing its SRV records after they were seen).
+    srv_refuse = False
 
 
 def _rr(name, rtype, ttl, target, port=None):
@@ -51,6 +54,10 @@ class FakeDnsClient:
         err = None
 
         tld = parts[0]
+        if Cfg.srv_refuse and qtype == 'SRV':
+            msg = DnsMessage(1234, 'NOERROR', False, [], [], [])
+            loop.call_soon(cb, DnsError('SERVFAIL', domain), msg)
+            return
         if tld == 'ok':
             if len(parts) > 2 and parts[1] == 'srv' and \
                     parts[2] in ('_tcp', '_udp') and qtype == 'SRV':

@@ -451,3 +451,78 @@ def test_removing_last_backend_rebal():
         resolver.stop()
         await wait_for_state(cset, 'stopped')
     run_async(t())
+
+
+def test_cset_failed_then_recovers():
+    """From 'failed', one successful monitor reconnect moves the set
+    back to 'running' and re-advertises (cset.py state_failed
+    on_connected; reference lib/set.js failed-state semantics)."""
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(
+            ctx, target=1, maximum=2,
+            recovery={'default': {'timeout': 300, 'retries': 0,
+                                  'delay': 0}})
+        inset = []
+        cset.on('added', lambda key, conn, hdl: inset.append(key))
+
+        def on_removed(key, conn, hdl):
+            if key in inset:
+                inset.remove(key)
+            hdl.release()
+        cset.on('removed', on_removed)
+
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+            asyncio.get_running_loop().call_soon(
+                lambda c=c: (c.destroy(), c.emit('close')))
+        await asyncio.sleep(0.8)
+        assert cset.is_in_state('failed')
+
+        # Let the monitor's next attempt succeed.
+        for _ in range(100):
+            fresh = [c for c in ctx.connections if not c.connected]
+            if fresh:
+                fresh[0].connect()
+                break
+            await asyncio.sleep(0.05)
+        await wait_for_state(cset, 'running', timeout=5)
+        await settle()
+        assert len(inset) == 1
+        assert cset.get_connections(), 'recovered conn not advertised'
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
+
+
+def test_cset_reshuffle_preserves_key_set():
+    """Decoherence reshuffle permutes the preference list without
+    gaining/losing keys; single-key sets are untouched
+    (cset.py reshuffle; reference lib/set.js + lib/pool.js:501-519)."""
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=1, maximum=4)
+        cset.on('added', lambda key, conn, hdl: None)
+        cset.on('removed', lambda key, conn, hdl: hdl.release())
+        for k in ('b1', 'b2', 'b3', 'b4'):
+            inner.emit('added', k, {})
+        await settle()
+        for c in list(ctx.connections):
+            if not c.connected:
+                c.connect()
+        await settle()
+        before = list(cset.cs_keys)
+        import random
+        random.seed(7)
+        for _ in range(8):
+            cset.reshuffle()
+        assert sorted(cset.cs_keys) == sorted(before)
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())

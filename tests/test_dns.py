@@ -25,6 +25,7 @@ def fake_v6(monkeypatch):
     Cfg.use_a2 = False
     Cfg.srv_ttl = 3600
     Cfg.flaky_fails = {}
+    Cfg.srv_refuse = False
     yield
 
 
@@ -525,4 +526,46 @@ def test_cname_answers_are_skipped():
         ans, ttl = got[0]
         assert ans == [{'name': 'real.example', 'address': '9.9.9.9'}]
         assert inner.r_counters.get('cname') == 1
+    run_async(t())
+
+
+def test_srv_antiflap_15min_fallback():
+    """A zone that answers A/AAAA but SERVFAILs every SRV query gets a
+    15-minute A/AAAA fallback window on SRV re-check instead of
+    hammering SRV at the record TTL (dns_resolver.py state_srv_error
+    anti-flap; reference lib/resolver.js:687-723)."""
+    async def t():
+        import time
+        Cfg.srv_refuse = True
+        try:
+            res, client = make_res(
+                'a.short-ttl',      # A records with 1s TTL
+                recovery={'default': {'timeout': 200, 'retries': 2,
+                                      'delay': 20}})
+            backends = []
+            res.on('added', lambda k, b: backends.append(b))
+            res.start()
+            await wait_for_state(res, 'running', timeout=10)
+            inner = res.r_fsm
+            assert not inner.r_have_seen_srv
+            assert inner.r_have_seen_addr
+            assert backends[0]['address'] == '1.2.3.4'
+
+            # Force the next SRV re-check to be due now; the 1s A-TTL
+            # wakeup recomputes the schedule, re-asks SRV, exhausts the
+            # SERVFAIL ladder, and engages the 15-min fallback.
+            inner.r_next_service = time.time() - 1
+            deadline = asyncio.get_running_loop().time() + 10
+            while inner.r_next_service - time.time() < 800:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    'anti-flap SRV backoff never engaged'
+                await asyncio.sleep(0.1)
+            delta = inner.r_next_service - time.time()
+            assert 800 < delta <= 901
+            # Still serving the plain-name backend, no flap.
+            assert res.count() == 1
+        finally:
+            Cfg.srv_refuse = False
+        res.stop()
+        await wait_for_state(res, 'stopped')
     run_async(t())
