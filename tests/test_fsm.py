@@ -506,3 +506,30 @@ def test_check_and_run_transition_overrides_dispatched():
             m._goto_state('forbidden')
         assert m.get_state() == 'b'
     run_async(t())
+
+
+def test_get_loop_outside_loop_raises_helpfully():
+    """FSM timer scheduling outside asyncio.run() must fail with the
+    explanatory error, not a bare 'no running event loop'."""
+    from cueball_tpu.fsm import get_loop
+    with pytest.raises(RuntimeError, match='running loop'):
+        get_loop()
+
+
+def test_remove_unregistered_tracer_is_noop():
+    remove_transition_tracer(lambda *a: None)   # must not raise
+
+
+def test_goto_unknown_state_raises():
+    async def t():
+        class Free(FSM):
+            def __init__(self):
+                super().__init__('a')
+
+            def state_a(self, S):
+                pass   # no validTransitions: any name is permitted
+
+        m = Free()
+        with pytest.raises(RuntimeError, match='unknown state'):
+            m._goto_state('purple')
+    run_async(t())
