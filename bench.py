@@ -191,7 +191,7 @@ def bench_telemetry_step():
     try:
         import jax
     except ImportError:
-        return None, None, None
+        return None, None, None, None
     from __graft_entry__ import entry
     from cueball_tpu.parallel.telemetry import (fleet_step_pallas,
                                                 fleet_step_xla)
@@ -213,7 +213,29 @@ def bench_telemetry_step():
         pallas_rate = rate(fleet_step_pallas)
     except Exception:      # pallas unavailable on this backend
         pallas_rate = None
-    return xla_rate, pallas_rate, str(jax.devices()[0])
+
+    # Offline-replay form: one lax.scan call per 64-tick window
+    # (amortizes per-step dispatch; telemetry.fleet_scan).
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    from cueball_tpu.parallel.telemetry import fleet_scan
+    state, inp = args
+    T = 64
+    window = jtu.tree_map(
+        lambda x: jnp.broadcast_to(x, (T,) + x.shape), inp)
+    window = window._replace(
+        now_ms=inp.now_ms + 100.0 * jnp.arange(T, dtype=jnp.float32))
+    out = fleet_scan(state, window)
+    jax.block_until_ready(out)  # compile
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fleet_scan(state, window)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    scan_rate = inp.samples.shape[0] * T * iters / dt
+
+    return xla_rate, pallas_rate, scan_rate, str(jax.devices()[0])
 
 
 def bench_telemetry_step_guarded(timeout_s: float = 300.0):
@@ -248,13 +270,13 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0):
                'unavailable)' % timeout_s)
     print('bench: %s; reporting host metrics only' % err,
           file=sys.stderr)
-    return None, None, None, err
+    return None, None, None, None, err
 
 
 async def main():
     abs_err = await bench_codel_tracking()
     claim_mean, claim_stdev, claim_trials = await bench_claim_throughput()
-    telem_xla, telem_pallas, device, telem_err = \
+    telem_xla, telem_pallas, telem_scan, device, telem_err = \
         bench_telemetry_step_guarded()
 
     result = {
@@ -279,6 +301,8 @@ async def main():
         if telem_xla else None,
         'telemetry_pools_per_sec_pallas': round(telem_pallas, 1)
         if telem_pallas else None,
+        'telemetry_pools_per_sec_scan': round(telem_scan, 1)
+        if telem_scan else None,
         'device': device,
         'targets_ms': TARGETS,
     }

@@ -15,9 +15,11 @@ monitor each LP tick and publishes the batched decisions.
 
 from .sampler import FleetSampler
 from .telemetry import (FleetInputs, FleetState, fleet_init,
-                        fleet_inputs, fleet_step, make_sharded_step,
-                        make_shardmap_step, shard_inputs, shard_state)
+                        fleet_inputs, fleet_scan, fleet_step,
+                        make_sharded_step, make_shardmap_step,
+                        shard_inputs, shard_state)
 
 __all__ = ['FleetInputs', 'FleetSampler', 'FleetState', 'fleet_init',
-           'fleet_inputs', 'fleet_step', 'make_sharded_step',
-           'make_shardmap_step', 'shard_inputs', 'shard_state']
+           'fleet_inputs', 'fleet_scan', 'fleet_step',
+           'make_sharded_step', 'make_shardmap_step', 'shard_inputs',
+           'shard_state']

@@ -264,3 +264,53 @@ def test_shardmap_fleet_step_on_mesh():
     for k in f_un:
         np.testing.assert_allclose(float(f_sm[k]), float(f_un[k]),
                                    rtol=1e-4, err_msg=k)
+
+
+def test_fleet_scan_matches_sequential_steps():
+    """fleet_scan over a [T, P] window == T sequential fleet_step
+    calls, state carried through (same laws, one compiled scan)."""
+    from cueball_tpu.parallel import (fleet_init, fleet_inputs,
+                                      fleet_scan, fleet_step)
+    rng = np.random.default_rng(13)
+    T, n = 12, 8
+
+    def tick(t):
+        return fleet_inputs(
+            n,
+            samples=rng.uniform(0, 6, n).astype(np.float32),
+            sojourns=rng.uniform(0, 400, n).astype(np.float32),
+            target_delay=np.full(n, 200.0, np.float32),
+            spares=np.full(n, 2.0, np.float32),
+            active=np.ones(n, bool),
+            reset=(np.arange(n) == t % n) if t == 5 else
+            np.zeros(n, bool),
+            now_ms=np.float32(100.0 * (t + 1)))
+
+    ticks = [tick(t) for t in range(T)]
+
+    state = fleet_init(n)
+    seq_outs, seq_fleets = [], []
+    for inp in ticks:
+        state, out, fleet = fleet_step(state, inp)
+        seq_outs.append(out)
+        seq_fleets.append(fleet)
+    seq_final = state
+
+    import jax.tree_util as jtu
+    stacked = jtu.tree_map(lambda *xs: jnp.stack(xs), *ticks)
+    scan_final, scan_outs, scan_fleets = fleet_scan(
+        fleet_init(n), stacked)
+
+    np.testing.assert_allclose(np.asarray(scan_final.windows),
+                               np.asarray(seq_final.windows), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(scan_final.codel.count),
+                               np.asarray(seq_final.codel.count),
+                               rtol=1e-5)
+    for k in seq_outs[0]:
+        expect = np.stack([np.asarray(o[k]) for o in seq_outs])
+        np.testing.assert_allclose(np.asarray(scan_outs[k]), expect,
+                                   rtol=1e-4, err_msg=k)
+    for k in seq_fleets[0]:
+        expect = np.stack([np.asarray(f[k]) for f in seq_fleets])
+        np.testing.assert_allclose(np.asarray(scan_fleets[k]), expect,
+                                   rtol=1e-4, err_msg=k)
