@@ -284,3 +284,37 @@ def test_http_body_drain_and_oversize_line():
 
         server.close()
     run_async(t())
+
+
+def test_fleet_detach_and_unregister_asserts():
+    async def t():
+        # Fleet section is absent until a sampler attaches.
+        assert pool_monitor.fleet_snapshot() == {'attached': False}
+
+        class FakeSampler:
+            def snapshot(self):
+                return {'ticks': 7}
+        pool_monitor.attach_fleet_sampler(FakeSampler())
+        snap = pool_monitor.fleet_snapshot()
+        assert snap['attached'] is True and snap['ticks'] == 7
+        pool_monitor.detach_fleet_sampler()
+        assert pool_monitor.fleet_snapshot() == {'attached': False}
+
+        # Unregistering something never registered is a hard assert
+        # (reference lib/pool-monitor.js mod_assert.ok guards).
+        class Ghost:
+            p_uuid = 'no-such-pool'
+            cs_uuid = 'no-such-set'
+            r_uuid = 'no-such-res'
+        import pytest
+        with pytest.raises(AssertionError):
+            pool_monitor.unregister_pool(Ghost())
+        with pytest.raises(AssertionError):
+            pool_monitor.unregister_set(Ghost())
+        with pytest.raises(AssertionError):
+            pool_monitor.unregister_dns_resolver(Ghost())
+        with pytest.raises(ValueError):
+            pool_monitor.list_objects('bogus')
+        with pytest.raises(ValueError):
+            pool_monitor.get('bogus', 'x')
+    run_async(t())
