@@ -569,3 +569,41 @@ def test_srv_antiflap_15min_fallback():
         res.stop()
         await wait_for_state(res, 'stopped')
     run_async(t())
+
+
+def test_bootstrap_ns_topology_changes_propagate():
+    """Nameservers added/removed by the bootstrap resolver update the
+    dependent resolver's live r_resolvers list (dns_resolver.py
+    state_bootstrap_ns persistent listeners; reference
+    lib/resolver.js:513-540)."""
+    async def t():
+        from cueball_tpu.dns_resolver import DNSResolverFSM
+        DNSResolverFSM.bootstrap_resolvers = {}
+        Cfg.use_a2 = True
+        Cfg.srv_ttl = 1
+        client = FakeDnsClient()
+        res = DNSResolver({
+            'domain': 'a.ok', 'service': '_foo._tcp',
+            'defaultPort': 112, 'resolvers': ['srv.ok'],
+            'recovery': RECOVERY, 'dnsClient': client,
+        })
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+        inner = res.r_fsm
+        # srv.ok feeds a.ok (1.2.3.4), aaaa.ok (1234:abcd::1) and
+        # a2.ok (1.2.3.5 + 1234:abcd::2) as nameservers.
+        assert '1.2.3.5' in inner.r_resolvers
+
+        # a2 drops out of the SRV answer; within ~2 TTL windows the
+        # bootstrap emits 'removed' and the NS list shrinks.
+        Cfg.use_a2 = False
+        deadline = asyncio.get_running_loop().time() + 10
+        while '1.2.3.5' in inner.r_resolvers:
+            assert asyncio.get_running_loop().time() < deadline, \
+                'removed nameserver never propagated'
+            await asyncio.sleep(0.1)
+        assert '1.2.3.4' in inner.r_resolvers
+
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
