@@ -79,3 +79,19 @@ def test_inference_fleet_client_example():
     assert 'done: 24 ok, 0 failed' in r.stdout
     assert 'fleet telemetry (batched over 1 pool(s))' in r.stdout
     assert "'mean_load'" in r.stdout
+
+
+def test_telemetry_replay_example():
+    pytest.importorskip('jax')
+    driver = (
+        'import jax\n'
+        'jax.config.update("jax_platforms", "cpu")\n'
+        'import runpy, sys\n'
+        'sys.argv = ["telemetry_replay.py"]\n'
+        'runpy.run_path(%r, run_name="__main__")\n'
+        % os.path.join(ROOT, 'examples', 'telemetry_replay.py'))
+    r = subprocess.run([sys.executable, '-c', driver],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert 'one compiled scan' in r.stdout
+    assert 'overload fraction peaked' in r.stdout
