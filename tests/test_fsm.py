@@ -533,3 +533,21 @@ def test_goto_unknown_state_raises():
         with pytest.raises(RuntimeError, match='unknown state'):
             m._goto_state('purple')
     run_async(t())
+
+
+def test_remove_once_listener_by_original_function():
+    """remove_listener(event, fn) must find the once()-wrapper that
+    wraps fn (node semantics; the hot-path identity scan falls back to
+    the wrapper scan)."""
+    async def t():
+        e = EventEmitter()
+        calls = []
+
+        def fn():
+            calls.append(1)
+
+        e.once('x', fn)
+        e.remove_listener('x', fn)
+        e.emit('x')
+        assert calls == []
+    run_async(t())

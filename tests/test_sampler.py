@@ -356,3 +356,39 @@ def test_rebase_preserves_codel_decisions():
         return np.stack(drops)
 
     np.testing.assert_array_equal(run(False), run(True))
+
+
+def test_sampler_epoch_rebase_trigger():
+    """When the epoch-relative clock nears float32 decay, sample_once
+    rebases the carried state and advances the epoch (sampler.py
+    EPOCH_LIMIT path) without disturbing row assignment."""
+    async def t():
+        from cueball_tpu.parallel import sampler as mod_sampler
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+
+        s = FleetSampler({'interval': 1000})
+        pool_monitor.attach_fleet_sampler(s)
+        try:
+            s.sample_once()
+            # Pretend the process has been up past the float32-safe
+            # window: the next tick must rebase.
+            s.fs_epoch -= mod_sampler.EPOCH_LIMIT + 5000
+            epoch_before = s.fs_epoch
+            rec = s.sample_once()
+            assert rec is not None
+            assert s.fs_epoch > epoch_before, 'epoch did not advance'
+            # Post-rebase relative clock sits at the margin.
+            import cueball_tpu.utils as mod_utils
+            rel = mod_utils.current_millis() - s.fs_epoch
+            assert rel < mod_sampler.EPOCH_LIMIT / 2
+            assert pool.p_uuid in s.snapshot()['rows']
+        finally:
+            pool_monitor.detach_fleet_sampler()
+            pool.stop()
+    run_async(t())
