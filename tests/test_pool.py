@@ -688,3 +688,50 @@ def test_claim_task_cancellation_cancels_waiter():
         pool.stop()
         await wait_for_state(pool, 'stopped')
     run_async(t())
+
+
+def test_pool_creates_and_owns_its_resolver():
+    """With no 'resolver' option the pool builds its own DNSResolver
+    from domain/resolvers/service, starts it, and stops it again on
+    pool.stop() (pool.py ctor + state_stopping started-resolver path;
+    reference lib/pool.js:210-232)."""
+    async def t():
+        import struct as mod_struct
+        from cueball_tpu import dns_client as dc
+        from test_dns_client import ScriptedNS
+
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            ScriptedNS, local_addr=('127.0.0.1', 0))
+        ns_port = transport.get_extra_info('sockname')[1]
+
+        ctx = Ctx()
+        pool = ConnectionPool({
+            'domain': 'svc.test',
+            'service': '_foo._tcp',
+            'defaultPort': 8080,
+            'resolvers': ['127.0.0.1@%d' % ns_port],
+            'spares': 1, 'maximum': 2,
+            'constructor': lambda b: DummyConnection(ctx, b),
+            'recovery': {'default': {'timeout': 2000, 'retries': 2,
+                                     'delay': 100}},
+        })
+        assert pool.p_resolver_custom is False
+        deadline = loop.time() + 10
+        while not ctx.connections:
+            assert loop.time() < deadline, 'own resolver found nothing'
+            await asyncio.sleep(0.02)
+        for c in list(ctx.connections):
+            c.connect()
+        await wait_for_state(pool, 'running', timeout=10)
+        # ScriptedNS SRV answer: backend.<domain>:8080 -> A 10.1.2.3.
+        be = list(pool.p_backends.values())[0]
+        assert be['address'] == '10.1.2.3'
+
+        resolver = pool.p_resolver
+        pool.stop()
+        await wait_for_state(pool, 'stopped', timeout=10)
+        # The pool started it, the pool must have stopped it.
+        assert resolver.is_in_state('stopped')
+        transport.close()
+    run_async(t())
