@@ -696,8 +696,6 @@ def test_pool_creates_and_owns_its_resolver():
     pool.stop() (pool.py ctor + state_stopping started-resolver path;
     reference lib/pool.js:210-232)."""
     async def t():
-        import struct as mod_struct
-        from cueball_tpu import dns_client as dc
         from test_dns_client import ScriptedNS
 
         loop = asyncio.get_running_loop()
