@@ -176,6 +176,50 @@ async def bench_claim_throughput():
     return statistics.mean(rates), statistics.stdev(rates), rates
 
 
+QUEUED_OPS_PER_TRIAL = 4000
+QUEUED_OUTSTANDING = 32
+
+
+async def bench_queued_claim_throughput():
+    """The saturated-queue hot path (reference lib/pool.js:733-749
+    waiter drain + 929-951 idleq rip): 2 connections, 32 claims
+    outstanding at all times, each release immediately feeding the next
+    waiter. Same fixed-op trial protocol as the unqueued bench."""
+    import statistics
+    build_pool = make_fixture()
+    rates = []
+    warmups = 2   # the queued path needs two rounds to warm caches
+    for trial in range(CLAIM_TRIALS + warmups):
+        pool = build_pool()
+        await settle(pool)
+        done = asyncio.Event()
+        count = [0]
+
+        def make_claim():
+            def cb(err, hdl=None, conn=None):
+                assert err is None, err
+                count[0] += 1
+                hdl.release()
+                if count[0] >= QUEUED_OPS_PER_TRIAL:
+                    if not done.is_set():
+                        done.set()
+                    return
+                make_claim()
+            pool.claim_cb({}, cb)
+
+        t0 = time.perf_counter()
+        for _ in range(QUEUED_OUTSTANDING):
+            make_claim()
+        await done.wait()
+        elapsed = time.perf_counter() - t0
+        pool.stop()
+        while not pool.is_in_state('stopped'):
+            await asyncio.sleep(0.01)
+        if trial >= warmups:
+            rates.append(QUEUED_OPS_PER_TRIAL / elapsed)
+    return statistics.mean(rates), statistics.stdev(rates)
+
+
 def _default_is_pallas():
     """Ask telemetry which FIR path it actually ships here."""
     from cueball_tpu.ops.fir import fir_apply_pallas
@@ -276,6 +320,7 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0):
 async def main():
     abs_err = await bench_codel_tracking()
     claim_mean, claim_stdev, claim_trials = await bench_claim_throughput()
+    queued_mean, queued_stdev = await bench_queued_claim_throughput()
     telem_xla, telem_pallas, telem_scan, device, telem_err = \
         bench_telemetry_step_guarded()
 
@@ -291,6 +336,10 @@ async def main():
         'claim_release_trials': [round(r, 1) for r in claim_trials],
         'claim_release_protocol': '%d trials x %d fixed ops, 1 warmup' % (
             CLAIM_TRIALS, CLAIM_OPS_PER_TRIAL),
+        'claim_queued_ops_per_sec': round(queued_mean, 1),
+        'claim_queued_stdev': round(queued_stdev, 1),
+        'claim_queued_protocol': '%d trials x %d ops, %d outstanding' % (
+            CLAIM_TRIALS, QUEUED_OPS_PER_TRIAL, QUEUED_OUTSTANDING),
         # Headline = the rate of the path _default_fir actually ships
         # on this backend (pallas on TPU, einsum elsewhere).
         'telemetry_pools_per_sec': round(
