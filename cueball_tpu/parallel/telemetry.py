@@ -282,6 +282,52 @@ def make_sharded_step(mesh: Mesh):
                    out_shardings=out_shardings)
 
 
+def make_sharded_scan(mesh: Mesh):
+    """fleet_scan with the pools axis sharded over the mesh INSIDE the
+    scan: each device carries its pool shard through all T ticks, so a
+    whole recorded window replays data-parallel with the per-tick fleet
+    aggregates still reducing over ICI. The dryrun asserts it matches
+    the unsharded scan."""
+    pool = NamedSharding(mesh, P('pools'))
+    window_pool = NamedSharding(mesh, P(None, 'pools'))   # [T, P]
+    time_axis = NamedSharding(mesh, P(None))              # [T]
+    scalar = NamedSharding(mesh, P())
+
+    state_shardings = FleetState(
+        windows=NamedSharding(mesh, P('pools', None)),
+        codel=CodelState(pool, pool, pool, pool),
+        now_ms=scalar)
+    window_shardings = FleetInputs(
+        samples=window_pool, sojourns=window_pool,
+        target_delay=window_pool, spares=window_pool,
+        maximum=window_pool, retry_delay=window_pool,
+        retry_max_delay=window_pool, retry_attempt=window_pool,
+        n_retrying=window_pool, active=window_pool,
+        reset=window_pool, now_ms=time_axis)
+    out_shardings = (
+        state_shardings,
+        {'filtered': window_pool, 'target': window_pool,
+         'clamped': window_pool, 'drop': window_pool,
+         'retry_backoff': window_pool},
+        {'n_pools': time_axis, 'mean_load': time_axis,
+         'mean_filtered': time_axis, 'overload_frac': time_axis,
+         'max_sojourn': time_axis, 'retry_frac': time_axis,
+         'mean_retry_backoff': time_axis})
+
+    return jax.jit(fleet_scan,
+                   in_shardings=(state_shardings, window_shardings),
+                   out_shardings=out_shardings)
+
+
+def shard_window(window: FleetInputs, mesh: Mesh) -> FleetInputs:
+    """Place a [T, P] tick window onto the mesh (pools axis sharded)."""
+    window_pool = NamedSharding(mesh, P(None, 'pools'))
+    time_axis = NamedSharding(mesh, P(None))
+    return FleetInputs(
+        *[jax.device_put(x, window_pool) for x in window[:-1]],
+        now_ms=jax.device_put(window.now_ms, time_axis))
+
+
 def make_shardmap_step(mesh: Mesh):
     """The SPMD form of :func:`fleet_step`: shard_map over the 'pools'
     mesh axis with hand-written collectives — per-pool laws run on the
