@@ -27,6 +27,7 @@ capacity; `active` masks unoccupied rows out of the aggregates and
 
 from __future__ import annotations
 
+import functools
 import typing
 
 import jax
@@ -247,36 +248,44 @@ def rebase_state(state: FleetState, shift) -> FleetState:
         now_ms=jnp.maximum(state.now_ms - shift, 0.0))
 
 
+# The ONE enumeration of how fleet data shards over the 'pools' mesh
+# axis; every sharded entry point below derives from these three, so a
+# new FleetInputs/output field is placed in exactly one spot.
+
+def _step_shardings(mesh: Mesh):
+    """(state, inputs, (state, per-pool outs, aggregates)) shardings
+    for one fleet_step tick."""
+    pool = NamedSharding(mesh, P('pools'))
+    scalar = NamedSharding(mesh, P())
+    state = FleetState(
+        windows=NamedSharding(mesh, P('pools', None)),
+        codel=CodelState(pool, pool, pool, pool),
+        now_ms=scalar)
+    inputs = FleetInputs(
+        *([pool] * (len(FleetInputs._fields) - 1)), now_ms=scalar)
+    outs = (
+        state,
+        {'filtered': pool, 'target': pool, 'clamped': pool,
+         'drop': pool, 'retry_backoff': pool},
+        {'n_pools': scalar, 'mean_load': scalar, 'mean_filtered': scalar,
+         'overload_frac': scalar, 'max_sojourn': scalar,
+         'retry_frac': scalar, 'mean_retry_backoff': scalar})
+    return state, inputs, outs
+
+
+def _prepend_time_axis(sharding: NamedSharding, mesh: Mesh):
+    """Per-tick sharding -> whole-window sharding: a leading replicated
+    [T] axis in front of whatever the tick layout was."""
+    return NamedSharding(mesh, P(*((None,) + tuple(sharding.spec))))
+
+
 def make_sharded_step(mesh: Mesh):
     """Build a jitted step with every [pools, ...] array sharded over
     the mesh's 'pools' axis. The per-pool math is embarrassingly
     parallel (no resharding); the fleet aggregates compile to psum-style
     all-reduces over ICI."""
-    pool_sharding = NamedSharding(mesh, P('pools'))
-    window_sharding = NamedSharding(mesh, P('pools', None))
-    scalar = NamedSharding(mesh, P())
-
-    state_shardings = FleetState(
-        windows=window_sharding,
-        codel=CodelState(pool_sharding, pool_sharding, pool_sharding,
-                         pool_sharding),
-        now_ms=scalar)
-    input_shardings = FleetInputs(
-        samples=pool_sharding, sojourns=pool_sharding,
-        target_delay=pool_sharding, spares=pool_sharding,
-        maximum=pool_sharding, retry_delay=pool_sharding,
-        retry_max_delay=pool_sharding, retry_attempt=pool_sharding,
-        n_retrying=pool_sharding, active=pool_sharding,
-        reset=pool_sharding, now_ms=scalar)
-    out_shardings = (
-        state_shardings,
-        {'filtered': pool_sharding, 'target': pool_sharding,
-         'clamped': pool_sharding, 'drop': pool_sharding,
-         'retry_backoff': pool_sharding},
-        {'n_pools': scalar, 'mean_load': scalar, 'mean_filtered': scalar,
-         'overload_frac': scalar, 'max_sojourn': scalar,
-         'retry_frac': scalar, 'mean_retry_backoff': scalar})
-
+    state_shardings, input_shardings, out_shardings = \
+        _step_shardings(mesh)
     return jax.jit(fleet_step,
                    in_shardings=(state_shardings, input_shardings),
                    out_shardings=out_shardings)
@@ -288,44 +297,26 @@ def make_sharded_scan(mesh: Mesh):
     whole recorded window replays data-parallel with the per-tick fleet
     aggregates still reducing over ICI. The dryrun asserts it matches
     the unsharded scan."""
-    pool = NamedSharding(mesh, P('pools'))
-    window_pool = NamedSharding(mesh, P(None, 'pools'))   # [T, P]
-    time_axis = NamedSharding(mesh, P(None))              # [T]
-    scalar = NamedSharding(mesh, P())
-
-    state_shardings = FleetState(
-        windows=NamedSharding(mesh, P('pools', None)),
-        codel=CodelState(pool, pool, pool, pool),
-        now_ms=scalar)
-    window_shardings = FleetInputs(
-        samples=window_pool, sojourns=window_pool,
-        target_delay=window_pool, spares=window_pool,
-        maximum=window_pool, retry_delay=window_pool,
-        retry_max_delay=window_pool, retry_attempt=window_pool,
-        n_retrying=window_pool, active=window_pool,
-        reset=window_pool, now_ms=time_axis)
-    out_shardings = (
-        state_shardings,
-        {'filtered': window_pool, 'target': window_pool,
-         'clamped': window_pool, 'drop': window_pool,
-         'retry_backoff': window_pool},
-        {'n_pools': time_axis, 'mean_load': time_axis,
-         'mean_filtered': time_axis, 'overload_frac': time_axis,
-         'max_sojourn': time_axis, 'retry_frac': time_axis,
-         'mean_retry_backoff': time_axis})
-
+    state_shardings, window_shardings, scan_out = _scan_shardings(mesh)
     return jax.jit(fleet_scan,
                    in_shardings=(state_shardings, window_shardings),
-                   out_shardings=out_shardings)
+                   out_shardings=scan_out)
+
+
+def _scan_shardings(mesh: Mesh):
+    """Derive the [T, ...] window shardings from the per-tick specs."""
+    state, inputs, (_, outs, fleet) = _step_shardings(mesh)
+    prepend = functools.partial(_prepend_time_axis, mesh=mesh)
+    window = jax.tree.map(prepend, inputs)
+    # Final carried state has no time axis; stacked outs/fleet do.
+    return state, window, (state, jax.tree.map(prepend, outs),
+                           jax.tree.map(prepend, fleet))
 
 
 def shard_window(window: FleetInputs, mesh: Mesh) -> FleetInputs:
     """Place a [T, P] tick window onto the mesh (pools axis sharded)."""
-    window_pool = NamedSharding(mesh, P(None, 'pools'))
-    time_axis = NamedSharding(mesh, P(None))
-    return FleetInputs(
-        *[jax.device_put(x, window_pool) for x in window[:-1]],
-        now_ms=jax.device_put(window.now_ms, time_axis))
+    _, window_shardings, _ = _scan_shardings(mesh)
+    return jax.tree.map(jax.device_put, window, window_shardings)
 
 
 def make_shardmap_step(mesh: Mesh):
@@ -376,19 +367,10 @@ def make_shardmap_step(mesh: Mesh):
 
 
 def shard_state(state: FleetState, mesh: Mesh) -> FleetState:
-    pool_sharding = NamedSharding(mesh, P('pools'))
-    window_sharding = NamedSharding(mesh, P('pools', None))
-    scalar = NamedSharding(mesh, P())
-    return FleetState(
-        windows=jax.device_put(state.windows, window_sharding),
-        codel=CodelState(
-            *[jax.device_put(x, pool_sharding) for x in state.codel]),
-        now_ms=jax.device_put(state.now_ms, scalar))
+    state_shardings, _, _ = _step_shardings(mesh)
+    return jax.tree.map(jax.device_put, state, state_shardings)
 
 
 def shard_inputs(inp: FleetInputs, mesh: Mesh) -> FleetInputs:
-    pool_sharding = NamedSharding(mesh, P('pools'))
-    scalar = NamedSharding(mesh, P())
-    return FleetInputs(
-        *[jax.device_put(x, pool_sharding) for x in inp[:-1]],
-        now_ms=jax.device_put(inp.now_ms, scalar))
+    _, input_shardings, _ = _step_shardings(mesh)
+    return jax.tree.map(jax.device_put, inp, input_shardings)
