@@ -486,10 +486,16 @@ class CueBallClaimHandle(FSM):
     def _relinquish(self, event: str) -> None:
         if not self.is_in_state('claimed'):
             if self.is_in_state('released') or self.is_in_state('closed'):
+                # Name the first release's call site. Python stacks are
+                # oldest-first (unlike the reference's node stacks), so
+                # walk from the END, skipping this module's own capture
+                # frames, to reach the actual releaser.
                 who = 'unknown'
-                for line in (self.ch_release_stack or [])[2:]:
-                    if line.strip():
-                        who = line.strip()
+                for line in reversed(self.ch_release_stack or []):
+                    s = line.strip()
+                    if s.startswith('File "') and \
+                            'cueball_tpu' not in s.split(',')[0]:
+                        who = s
                         break
                 raise RuntimeError(
                     'Connection not claimed by this handle, released '

@@ -363,3 +363,69 @@ def test_ping_checker_runs_on_idle_timeout():
         assert len(checked) >= 2  # keeps re-arming while idle
         assert slot.is_in_state('idle')
     run_async(t())
+
+
+def test_double_release_names_original_releaser_with_capture():
+    """With stack capture enabled, the double-release error names who
+    released first (reference lib/connection-fsm.js release-stack
+    bookkeeping; docs/api.md claim-handle section)."""
+    async def t():
+        from cueball_tpu import utils as mod_utils
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        DummyConnection.instances[0].connect()
+        await settle()
+        hdl = make_handle(pool, lambda *a: None)
+        hdl.try_(slot)
+        await settle()
+        mod_utils.enable_stack_traces()
+        try:
+            hdl.release()
+            with pytest.raises(RuntimeError,
+                               match='released by.*test_connection_fsm'):
+                hdl.release()
+        finally:
+            mod_utils.disable_stack_traces()
+    run_async(t())
+
+
+def test_connect_error_and_timeout_events_counted():
+    """connectError and timeout socket events during connect move the
+    smgr to error/backoff and bump the pool's whitelisted error
+    counters (reference lib/connection-fsm.js connect dedup +
+    lib/utils.js metric whitelist)."""
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool, recov=recovery(retries=3, delay=30))
+        slot.start()
+        await settle()
+        smgr = slot.get_socket_mgr()
+
+        DummyConnection.instances[-1].emit('connectError',
+                                           RuntimeError('nope'))
+        await settle()
+        assert pool.counters.get('error-during-connect') == 1
+        assert smgr.is_in_state('backoff')
+
+        # Next attempt: times out.
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(DummyConnection.instances) < 2:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        DummyConnection.instances[-1].emit('timeout')
+        await settle()
+        assert pool.counters.get('timeout-during-connect') == 1
+
+        # And one closes mid-connect.
+        while len(DummyConnection.instances) < 3:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        DummyConnection.instances[-1].emit('close')
+        await settle()
+        assert pool.counters.get('close-during-connect') == 1
+
+        slot.set_unwanted()
+        await settle()
+    run_async(t())
