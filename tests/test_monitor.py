@@ -318,3 +318,46 @@ def test_fleet_detach_and_unregister_asserts():
         with pytest.raises(ValueError):
             pool_monitor.get('bogus', 'x')
     run_async(t())
+
+
+def test_http_parse_error_matrix():
+    """Each malformed-request class answers 400 and closes: bad
+    version, header without a colon, bad/oversized Content-Length,
+    header flood, EOF mid-headers."""
+    async def t():
+        server = await serve_monitor()
+        port = server.sockets[0].getsockname()[1]
+
+        async def send_raw(payload):
+            reader, writer = await asyncio.open_connection(
+                '127.0.0.1', port)
+            writer.write(payload)
+            await writer.drain()
+            line = await reader.readline()
+            writer.close()
+            return line
+
+        assert b'400' in await send_raw(
+            b'GET /kang/types HTTP/2.0\r\n\r\n')
+        assert b'400' in await send_raw(
+            b'GET /kang/types HTTP/1.1\r\nno-colon-here\r\n\r\n')
+        assert b'400' in await send_raw(
+            b'GET /x HTTP/1.1\r\nContent-Length: frog\r\n\r\n')
+        assert b'400' in await send_raw(
+            b'GET /x HTTP/1.1\r\nContent-Length: 9999999999\r\n\r\n')
+        flood = b''.join(b'H%d: v\r\n' % i for i in range(80))
+        assert b'400' in await send_raw(
+            b'GET /x HTTP/1.1\r\n' + flood + b'\r\n')
+
+        # EOF mid-headers: connection just closes, no crash.
+        reader, writer = await asyncio.open_connection('127.0.0.1', port)
+        writer.write(b'GET /kang/types HTTP/1.1\r\nHost: x\r\n')
+        await writer.drain()
+        writer.close()
+        await asyncio.sleep(0.05)
+
+        # Server is still healthy afterwards.
+        status, types = await _get(port, '/kang/types')
+        assert status == 200 and types == ['pool', 'set', 'dns_res']
+        server.close()
+    run_async(t())
