@@ -607,3 +607,42 @@ def test_bootstrap_ns_topology_changes_propagate():
         res.stop()
         await wait_for_state(res, 'stopped')
     run_async(t())
+
+
+def test_resolv_conf_parsing(tmp_path):
+    """nameserver lines parse with comments/garbage ignored; missing
+    file or no usable lines fall back to Google DNS (reference
+    lib/resolver.js:492-510)."""
+    from cueball_tpu.dns_resolver import _read_resolv_conf
+    p = tmp_path / 'resolv.conf'
+    p.write_text(
+        '# comment\n'
+        'search example.com\n'
+        'nameserver 10.0.0.53\n'
+        '  nameserver   fd00::53  \n'
+        'nameserver not-an-ip\n')
+    assert _read_resolv_conf(str(p)) == ['10.0.0.53', 'fd00::53']
+    assert _read_resolv_conf(str(tmp_path / 'missing')) == \
+        ['8.8.8.8', '8.8.4.4']
+    empty = tmp_path / 'empty.conf'
+    empty.write_text('search example.com\n')
+    assert _read_resolv_conf(str(empty)) == ['8.8.8.8', '8.8.4.4']
+
+
+def test_dns_resolver_ctor_validation():
+    """assert-plus style option checks (reference lib/resolver.js ctor
+    asserts)."""
+    good = {'domain': 'x.example', 'recovery': RECOVERY}
+    for bad in [
+        'not-a-dict',
+        {**good, 'domain': 42},
+        {**good, 'resolvers': '1.2.3.4'},          # must be a list
+        {**good, 'resolvers': [1, 2]},             # of strings
+        {k: v for k, v in good.items() if k != 'recovery'},
+    ]:
+        with pytest.raises(AssertionError):
+            DNSResolver(bad)
+    with pytest.raises(AssertionError):
+        DNSResolver({**good, 'recovery': {'default': {
+            'retries': 1, 'timeout': 100, 'delay': 10,
+            'bogusKey': 1}}})
