@@ -526,3 +526,80 @@ def test_cset_reshuffle_preserves_key_set():
         resolver.stop()
         await wait_for_state(cset, 'stopped')
     run_async(t())
+
+
+def test_connection_handles_error_option():
+    """connectionHandlesError=True: the consumer owns 'error' events on
+    advertised connections; an un-listened error while claimed is NOT
+    raised by cueball (handle created with throwError=False; reference
+    lib/set.js connectionHandlesError + lib/connection-fsm.js:697-709)."""
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(
+            ctx, target=1, maximum=2, connectionHandlesError=True)
+        added = []
+        cset.on('added', lambda key, conn, hdl: added.append((key, hdl)))
+        cset.on('removed', lambda key, conn, hdl: hdl.release())
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+        assert added
+        key, hdl = added[0]
+        assert hdl.ch_throw_error is False
+
+        # The connection errors with NO listener attached: with the
+        # option set this must not raise out of the emitter (cueball
+        # only logs); the slot sees the error and builds a replacement.
+        conn = ctx.connections[0]
+        conn.emit('error', RuntimeError('consumer-owned error'))
+        await settle()
+        fresh = [c for c in ctx.connections if not c.connected]
+        assert fresh, 'no replacement attempt after error'
+        fresh[0].connect()
+        await wait_for_state(cset, 'running', timeout=5)
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
+
+
+def test_release_before_removed_is_a_misuse_trap():
+    """ConnectionSet handles may be .close()d anytime but .release()d
+    only after 'removed' (cset.py state_advertised; reference
+    lib/set.js:757-791)."""
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=1, maximum=2)
+        added = []
+        cset.on('added', lambda key, conn, hdl: added.append(hdl))
+        # The misused handle is already 'released' when 'removed' fires.
+        cset.on('removed', lambda key, conn, hdl:
+                hdl.release() if hdl.is_in_state('claimed') else None)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+        # The trap fires from the deferred stateChanged listener, so it
+        # surfaces through the loop's exception handler (the
+        # crash-the-process semantics of the reference's assert_emit).
+        loop = asyncio.get_running_loop()
+        trapped = []
+        prev_handler = loop.get_exception_handler()
+        loop.set_exception_handler(
+            lambda lo, c: trapped.append(c.get('exception')))
+        try:
+            added[0].release()
+            await settle()
+        finally:
+            loop.set_exception_handler(prev_handler)
+        assert any('before "removed"' in str(e) for e in trapped
+                   if e is not None)
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
