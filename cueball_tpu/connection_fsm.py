@@ -488,13 +488,18 @@ class CueBallClaimHandle(FSM):
             if self.is_in_state('released') or self.is_in_state('closed'):
                 # Name the first release's call site. Python stacks are
                 # oldest-first (unlike the reference's node stacks), so
-                # walk from the END, skipping this module's own capture
-                # frames, to reach the actual releaser.
+                # walk from the END, skipping this package's own capture
+                # frames (matched by the package directory, not a bare
+                # substring — a repo cloned AS 'cueball_tpu/' must not
+                # have its own frames skipped), to reach the releaser.
+                import os
+                pkg_dir = os.path.dirname(os.path.abspath(__file__)) \
+                    + os.sep
                 who = 'unknown'
                 for line in reversed(self.ch_release_stack or []):
                     s = line.strip()
                     if s.startswith('File "') and \
-                            'cueball_tpu' not in s.split(',')[0]:
+                            pkg_dir not in s.split(',')[0]:
                         who = s
                         break
                 raise RuntimeError(
