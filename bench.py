@@ -283,41 +283,59 @@ def bench_telemetry_step():
 
 
 def bench_telemetry_step_guarded(timeout_s: float = 300.0):
-    """bench_telemetry_step with a watchdog: TPU backend acquisition
-    over the chip tunnel can wedge indefinitely (observed: jax client
-    init blocking > 10 min); the headline CoDel metric must still be
-    reported. The stage runs in a daemon thread and is abandoned on
-    timeout."""
+    """bench_telemetry_step in a KILLABLE subprocess with a watchdog.
+
+    Two reasons it must be a subprocess, not a thread: TPU backend
+    acquisition over the chip tunnel can wedge indefinitely (observed:
+    jax client init blocking > 10 min) and a wedged thread cannot be
+    killed; and when the tunnel is wedged, the axon machinery's retry
+    threads contend with the host benchmarks for the GIL (observed
+    halving claim throughput), so the main bench process pins itself to
+    CPU (see main()) and only this child ever touches the chip."""
+    import os
+    import subprocess
     import sys
-    import threading
-    box = {}
-
-    def run():
-        try:
-            box['result'] = bench_telemetry_step()
-        except Exception as e:          # report, don't kill the bench
-            box['error'] = e
-
-    # A plain daemon thread: ThreadPoolExecutor workers are joined at
-    # interpreter exit and would hang the process on a wedged tunnel.
-    t = threading.Thread(target=run, daemon=True, name='telem-bench')
-    t.start()
-    t.join(timeout_s)
-    if 'result' in box:
-        return box['result'] + (None,)
-    if 'error' in box:
-        # Distinguish a broken bench path from a missing accelerator in
-        # the JSON itself (a null rate alone would mask regressions).
-        err = 'telemetry stage failed: %r' % box['error']
-    else:
+    code = (
+        'import json, sys\n'
+        "sys.path.insert(0, %r)\n"
+        'import bench\n'
+        'xla, pallas, scan, dev = bench.bench_telemetry_step()\n'
+        'print(json.dumps([xla, pallas, scan, dev]))\n'
+    ) % os.path.dirname(os.path.abspath(__file__))
+    try:
+        r = subprocess.run([sys.executable, '-c', code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
         err = ('telemetry stage timed out after %gs (accelerator '
                'unavailable)' % timeout_s)
-    print('bench: %s; reporting host metrics only' % err,
-          file=sys.stderr)
-    return None, None, None, None, err
+        print('bench: %s; reporting host metrics only' % err,
+              file=sys.stderr)
+        return None, None, None, None, err
+    if r.returncode != 0:
+        # Distinguish a broken bench path from a missing accelerator in
+        # the JSON itself (a null rate alone would mask regressions).
+        err = 'telemetry stage failed: %s' % (
+            r.stderr.strip().splitlines()[-1] if r.stderr.strip()
+            else 'exit %d' % r.returncode)
+        print('bench: %s; reporting host metrics only' % err,
+              file=sys.stderr)
+        return None, None, None, None, err
+    xla, pallas, scan, dev = json.loads(r.stdout.strip().splitlines()[-1])
+    return xla, pallas, scan, dev, None
 
 
 async def main():
+    # Pin THIS process to CPU: the host benchmarks must not share the
+    # GIL with the axon tunnel machinery (its retry threads measurably
+    # depress claim throughput when the chip tunnel is unhealthy). The
+    # telemetry stage reaches the chip from its own subprocess.
+    try:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+
     abs_err = await bench_codel_tracking()
     claim_mean, claim_stdev, claim_trials = await bench_claim_throughput()
     queued_mean, queued_stdev = await bench_queued_claim_throughput()
