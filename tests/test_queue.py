@@ -63,3 +63,20 @@ def test_interleaved_push_shift():
     assert q.shift() == 2
     assert q.shift() == 3
     assert q.is_empty()
+
+
+def test_queue_peek_iter_and_for_each():
+    q = Queue()
+    assert q.peek() is None
+    n1 = q.push('a')
+    q.push('b')
+    assert q.peek() == 'a'
+    assert q.length == 2
+    assert list(q) == ['a', 'b']
+    seen = []
+    q.for_each(seen.append)
+    assert seen == ['a', 'b']
+    # Unlinked nodes vanish from iteration but leave peek coherent.
+    n1.remove()
+    assert q.peek() == 'b'
+    assert list(q) == ['b']
