@@ -325,3 +325,11 @@ def test_gauge_serialization_type_line():
     text = g.serialize()
     assert '# TYPE open_conns gauge' in text
     assert 'Live counter of open connections' in text
+
+
+def test_make_child_logger_none_falls_back():
+    import logging
+    from cueball_tpu.utils import make_child_logger
+    lg = make_child_logger(None, component='X')
+    assert lg.logger is logging.getLogger('cueball')
+    assert lg.extra == {'component': 'X'}
