@@ -17,6 +17,7 @@ Secondary fields: raw claim/release hot-path throughput on a saturated
 
 import asyncio
 import json
+import os
 import time
 
 TARGETS = [300, 500, 1000, 1500, 2000, 2500, 5000]
@@ -156,24 +157,56 @@ async def bench_claim_throughput():
 
     Fixed-op-count trials (every trial does the same work), one warmup
     trial discarded, then CLAIM_TRIALS measured trials reported as
-    mean +/- stdev."""
+    mean +/- stdev. BENCH_r03's trials were bimodal (11.2k-18.4k,
+    14.9% stdev), so each timed section now runs with the cyclic GC
+    disabled (a mid-trial gen-2 sweep over the whole heap is exactly a
+    trial-length anomaly) and collects between trials instead; the
+    long-lived heap is frozen out of the collector once after warmup;
+    and every trial records its context-switch deltas so any residual
+    outlier carries its own diagnosis in the JSON."""
+    import gc
     import statistics
+    try:
+        import resource
+    except ImportError:      # non-Unix: degrade to empty diags
+        resource = None
     build_pool = make_fixture()
     rates = []
+    diags = []
     for trial in range(CLAIM_TRIALS + 1):
+        if trial == 1:
+            # Warmup is done and its garbage collected; what remains
+            # (modules, the fixture, the event loop) is long-lived:
+            # move it to the permanent generation so inter-trial
+            # collect()s never re-walk it. Collect-then-freeze order
+            # per the gc docs, and before this trial's pool exists so
+            # every measured pool lives in the same (unfrozen) heap.
+            gc.collect()
+            gc.freeze()
         pool = build_pool()
         await settle(pool)
+        gc.collect()
+        ru0 = resource.getrusage(resource.RUSAGE_SELF) if resource \
+            else None
+        gc.disable()
         t0 = time.perf_counter()
         for _ in range(CLAIM_OPS_PER_TRIAL):
             hdl, conn = await pool.claim({'timeout': 1000})
             hdl.release()
         elapsed = time.perf_counter() - t0
+        gc.enable()
+        ru1 = resource.getrusage(resource.RUSAGE_SELF) if resource \
+            else None
         pool.stop()
         while not pool.is_in_state('stopped'):
             await asyncio.sleep(0.01)
         if trial > 0:            # trial 0 is warmup
             rates.append(CLAIM_OPS_PER_TRIAL / elapsed)
-    return statistics.mean(rates), statistics.stdev(rates), rates
+            diags.append({
+                'nvcsw': ru1.ru_nvcsw - ru0.ru_nvcsw,
+                'nivcsw': ru1.ru_nivcsw - ru0.ru_nivcsw,
+            } if resource else {})
+    return statistics.mean(rates), statistics.stdev(rates), rates, diags
 
 
 QUEUED_OPS_PER_TRIAL = 4000
@@ -184,14 +217,22 @@ async def bench_queued_claim_throughput():
     """The saturated-queue hot path (reference lib/pool.js:733-749
     waiter drain + 929-951 idleq rip): 2 connections, 32 claims
     outstanding at all times, each release immediately feeding the next
-    waiter. Same fixed-op trial protocol as the unqueued bench."""
+    waiter. Same fixed-op trial protocol and GC discipline as the
+    unqueued bench (the claim bench already froze the long-lived
+    heap; freeze() here is idempotent for anything it added)."""
+    import gc
     import statistics
     build_pool = make_fixture()
     rates = []
     warmups = 2   # the queued path needs two rounds to warm caches
     for trial in range(CLAIM_TRIALS + warmups):
+        if trial == warmups:
+            gc.collect()
+            gc.freeze()
         pool = build_pool()
         await settle(pool)
+        gc.collect()
+        gc.disable()
         done = asyncio.Event()
         count = [0]
 
@@ -212,6 +253,7 @@ async def bench_queued_claim_throughput():
             make_claim()
         await done.wait()
         elapsed = time.perf_counter() - t0
+        gc.enable()
         pool.stop()
         while not pool.is_in_state('stopped'):
             await asyncio.sleep(0.01)
@@ -221,7 +263,11 @@ async def bench_queued_claim_throughput():
 
 
 def _default_is_pallas():
-    """Ask telemetry which FIR path it actually ships here."""
+    """Ask telemetry which FIR path it actually ships here.
+
+    Only meaningful in a process that sees the real backend: main()
+    pins the parent to CPU, so this must be asked inside the telemetry
+    subprocess (ADVICE r3) — its answer rides home in the child JSON."""
     from cueball_tpu.ops.fir import fir_apply_pallas
     from cueball_tpu.parallel.telemetry import _default_fir
     return _default_fir() is fir_apply_pallas
@@ -235,7 +281,7 @@ def bench_telemetry_step():
     try:
         import jax
     except ImportError:
-        return None, None, None, None
+        return None, None, None, None, None
     from __graft_entry__ import entry
     from cueball_tpu.parallel.telemetry import (fleet_step_pallas,
                                                 fleet_step_xla)
@@ -279,7 +325,8 @@ def bench_telemetry_step():
     dt = time.perf_counter() - t0
     scan_rate = inp.samples.shape[0] * T * iters / dt
 
-    return xla_rate, pallas_rate, scan_rate, str(jax.devices()[0])
+    return (xla_rate, pallas_rate, scan_rate, str(jax.devices()[0]),
+            _default_is_pallas())
 
 
 def bench_telemetry_step_guarded(timeout_s: float = 300.0):
@@ -292,15 +339,20 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0):
     threads contend with the host benchmarks for the GIL (observed
     halving claim throughput), so the main bench process pins itself to
     CPU (see main()) and only this child ever touches the chip."""
-    import os
     import subprocess
     import sys
     code = (
-        'import json, sys\n'
+        'import json, os, sys\n'
+        # Undo the parent's single-core pin (inherited): XLA wants its
+        # compile/runtime threads spread over every core.
+        'try:\n'
+        '    os.sched_setaffinity(0, range(os.cpu_count() or 1))\n'
+        'except (AttributeError, OSError):\n'
+        '    pass\n'
         "sys.path.insert(0, %r)\n"
         'import bench\n'
-        'xla, pallas, scan, dev = bench.bench_telemetry_step()\n'
-        'print(json.dumps([xla, pallas, scan, dev]))\n'
+        'xla, pallas, scan, dev, is_pallas = bench.bench_telemetry_step()\n'
+        'print(json.dumps([xla, pallas, scan, dev, is_pallas]))\n'
     ) % os.path.dirname(os.path.abspath(__file__))
     try:
         r = subprocess.run([sys.executable, '-c', code],
@@ -311,7 +363,9 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0):
                'unavailable)' % timeout_s)
         print('bench: %s; reporting host metrics only' % err,
               file=sys.stderr)
-        return None, None, None, None, err
+        # None (JSON null) = not measured, as distinct from a measured
+        # einsum default.
+        return None, None, None, None, None, err
     if r.returncode != 0:
         # Distinguish a broken bench path from a missing accelerator in
         # the JSON itself (a null rate alone would mask regressions).
@@ -320,9 +374,10 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0):
             else 'exit %d' % r.returncode)
         print('bench: %s; reporting host metrics only' % err,
               file=sys.stderr)
-        return None, None, None, None, err
-    xla, pallas, scan, dev = json.loads(r.stdout.strip().splitlines()[-1])
-    return xla, pallas, scan, dev, None
+        return None, None, None, None, None, err
+    xla, pallas, scan, dev, is_pallas = \
+        json.loads(r.stdout.strip().splitlines()[-1])
+    return xla, pallas, scan, dev, is_pallas, None
 
 
 async def main():
@@ -335,12 +390,22 @@ async def main():
         jax.config.update('jax_platforms', 'cpu')
     except Exception:
         pass
+    # Pin to ONE core (the highest-numbered, away from irq-heavy core
+    # 0): the host benches are single-threaded asyncio, and scheduler
+    # migrations were a suspect in BENCH_r03's bimodal trials. The
+    # telemetry subprocess resets its own affinity (it wants the
+    # compiler's threads spread out).
+    try:
+        os.sched_setaffinity(0, {max(os.sched_getaffinity(0))})
+    except (AttributeError, OSError):
+        pass
 
     abs_err = await bench_codel_tracking()
-    claim_mean, claim_stdev, claim_trials = await bench_claim_throughput()
+    (claim_mean, claim_stdev, claim_trials,
+     claim_diags) = await bench_claim_throughput()
     queued_mean, queued_stdev = await bench_queued_claim_throughput()
-    telem_xla, telem_pallas, telem_scan, device, telem_err = \
-        bench_telemetry_step_guarded()
+    (telem_xla, telem_pallas, telem_scan, device, default_is_pallas,
+     telem_err) = bench_telemetry_step_guarded()
 
     result = {
         'metric': 'codel_claim_delay_abs_error_ms',
@@ -352,18 +417,25 @@ async def main():
         'claim_release_ops_per_sec': round(claim_mean, 1),
         'claim_release_stdev': round(claim_stdev, 1),
         'claim_release_trials': [round(r, 1) for r in claim_trials],
-        'claim_release_protocol': '%d trials x %d fixed ops, 1 warmup' % (
+        'claim_release_protocol': ('%d trials x %d fixed ops, 1 warmup, '
+                                   'gc frozen+disabled in timed section, '
+                                   'single-core affinity') % (
             CLAIM_TRIALS, CLAIM_OPS_PER_TRIAL),
+        'claim_release_trial_diags': claim_diags,
         'claim_queued_ops_per_sec': round(queued_mean, 1),
         'claim_queued_stdev': round(queued_stdev, 1),
         'claim_queued_protocol': '%d trials x %d ops, %d outstanding' % (
             CLAIM_TRIALS, QUEUED_OPS_PER_TRIAL, QUEUED_OUTSTANDING),
         # Headline = the rate of the path _default_fir actually ships
-        # on this backend (pallas on TPU, einsum elsewhere).
+        # on the SUBPROCESS's backend (pallas on TPU, einsum
+        # elsewhere) — asked in the child, which sees the real chip;
+        # this parent is CPU-pinned so asking here would always say
+        # einsum (ADVICE r3).
         'telemetry_pools_per_sec': round(
             telem_pallas if (telem_pallas is not None and
-                             _default_is_pallas()) else telem_xla, 1)
+                             default_is_pallas) else telem_xla, 1)
         if telem_xla else None,
+        'telemetry_default_is_pallas': default_is_pallas,
         'telemetry_pools_per_sec_xla': round(telem_xla, 1)
         if telem_xla else None,
         'telemetry_pools_per_sec_pallas': round(telem_pallas, 1)

@@ -107,8 +107,9 @@ def _on_debug_signal(signum, frame) -> None:
     The toggle itself is plain Python state (safe at any interrupt
     point); the dump + logging are NOT reentrancy-safe (a buffered
     stream write interrupted mid-write raises RuntimeError), so when an
-    asyncio loop is running they are deferred to it via call_soon and
-    only run inline as a last resort."""
+    asyncio loop is running they are deferred to it via
+    call_soon_threadsafe (the only call_soon variant documented safe
+    from signal handlers) and only run inline as a last resort."""
     if mod_utils.stack_traces_enabled():
         mod_utils.disable_stack_traces()
     else:
@@ -119,7 +120,7 @@ def _on_debug_signal(signum, frame) -> None:
     except RuntimeError:
         loop = None
     if loop is not None:
-        loop.call_soon(_emit_dump, signum)
+        loop.call_soon_threadsafe(_emit_dump, signum)
     else:
         _emit_dump(signum)
 

@@ -77,7 +77,9 @@ async def _read_request(reader):
         return 400
 
     headers = {}
-    for _ in range(_MAX_HEADERS):
+    # One extra iteration beyond the cap belongs to the blank
+    # terminator line, so exactly _MAX_HEADERS headers are accepted.
+    for _ in range(_MAX_HEADERS + 1):
         try:
             h = await reader.readline()
         except ValueError:

@@ -349,6 +349,17 @@ def test_http_parse_error_matrix():
         assert b'400' in await send_raw(
             b'GET /x HTTP/1.1\r\n' + flood + b'\r\n')
 
+        # Exactly _MAX_HEADERS headers is allowed (the terminator line
+        # doesn't count against the cap — ADVICE r3 off-by-one), one
+        # more is a flood.
+        from cueball_tpu.http_server import _MAX_HEADERS
+        at_cap = b''.join(b'H%d: v\r\n' % i for i in range(_MAX_HEADERS))
+        assert b'200' in await send_raw(
+            b'GET /kang/types HTTP/1.1\r\n' + at_cap + b'\r\n')
+        over = at_cap + b'Hx: v\r\n'
+        assert b'400' in await send_raw(
+            b'GET /kang/types HTTP/1.1\r\n' + over + b'\r\n')
+
         # EOF mid-headers: connection just closes, no crash.
         reader, writer = await asyncio.open_connection('127.0.0.1', port)
         writer.write(b'GET /kang/types HTTP/1.1\r\nHost: x\r\n')
