@@ -10,8 +10,8 @@ The pool damps shrinkage with a 128-tap EMA FIR sampled at 5 Hz
 - :func:`fir_smooth` — full filtered history for offline analysis.
 - :func:`fir_apply_pallas` — the same matvec as a pallas TPU kernel
   (VMEM-blocked over pools; K=128 lands exactly on the lane width).
-  Measured 1.50x the XLA einsum on TPU v5 lite (20.3M vs 13.6M
-  pools/s through the full fleet_step, BENCH_r03), so it is the
+  Measured 1.29x the XLA einsum on TPU v5 lite (19.4M vs 15.0M
+  pools/s through the full fleet_step, BENCH_TPU.json), so it is the
   telemetry default on TPU (parallel/telemetry.py _default_fir);
   off-TPU it only runs interpreted and the einsum is the default.
 """

@@ -87,8 +87,8 @@ def fleet_inputs(n_pools: int, **kw) -> FleetInputs:
 
 def _default_fir():
     """FIR implementation for this backend: the pallas kernel on TPU
-    (measured 1.50x the XLA einsum on v5 lite — 20.3M vs 13.6M pools/s,
-    BENCH_r03), the XLA einsum elsewhere (pallas would only run in
+    (measured 1.29x the XLA einsum on v5 lite — 19.4M vs 15.0M pools/s,
+    BENCH_TPU.json), the XLA einsum elsewhere (pallas would only run in
     interpret mode off-TPU)."""
     return fir_apply_pallas if jax.default_backend() == 'tpu' \
         else fir_apply
