@@ -152,11 +152,16 @@ class HttpSocket(EventEmitter):
 
 class HttpResponse:
     def __init__(self, status: int, reason: str, headers: dict,
-                 body: bytes):
+                 body: bytes, raw_headers: list | None = None):
         self.status = status
         self.status_code = status
         self.reason = reason
         self.headers = headers
+        # Ordered (name, value) pairs with duplicates preserved
+        # (Set-Cookie needs this); the dict above keeps the
+        # last-wins convenience view.
+        self.raw_headers = raw_headers if raw_headers is not None \
+            else list(headers.items())
         self.body = body
 
     def text(self, encoding='utf-8') -> str:
@@ -175,12 +180,14 @@ async def _read_response(reader: asyncio.StreamReader,
     reason = parts[2] if len(parts) > 2 else ''
 
     headers: dict[str, str] = {}
+    raw_headers: list[tuple[str, str]] = []
     while True:
         line = await reader.readline()
         if line in (b'\r\n', b'\n', b''):
             break
         k, _, v = line.decode('latin-1').partition(':')
         headers[k.strip().lower()] = v.strip()
+        raw_headers.append((k.strip(), v.strip()))
 
     keep_alive = version != 'HTTP/1.0'
     conn_hdr = headers.get('connection', '').lower()
@@ -217,7 +224,8 @@ async def _read_response(reader: asyncio.StreamReader,
         body = await reader.read()
         keep_alive = False
 
-    return HttpResponse(status, reason, headers, body), keep_alive
+    return HttpResponse(status, reason, headers, body,
+                        raw_headers=raw_headers), keep_alive
 
 
 class CueBallAgent(EventEmitter):
@@ -283,6 +291,11 @@ class CueBallAgent(EventEmitter):
         return construct
 
     def _add_pool(self, host: str, options: dict) -> ConnectionPool:
+        # The reference keys this.pools by bare hostname
+        # (lib/agent.js:105-211); integration layers that must
+        # distinguish ports pass an explicit poolKey instead of
+        # reaching into the dicts.
+        key = options.get('poolKey') or host
         port = options.get('port') or self.default_port
         resolver = options.get('resolver')
         if resolver is None:
@@ -316,8 +329,8 @@ class CueBallAgent(EventEmitter):
         pool = ConnectionPool(pool_opts)
         if resolver.is_in_state('stopped'):
             resolver.start()
-        self.pools[host] = pool
-        self.pool_resolvers[host] = resolver
+        self.pools[key] = pool
+        self.pool_resolvers[key] = resolver
         return pool
 
     def get_pool(self, host: str) -> ConnectionPool | None:

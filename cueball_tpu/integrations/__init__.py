@@ -1,0 +1,17 @@
+"""Ecosystem drop-in integrations.
+
+The reference's flagship adoption property is that its HttpAgent is a
+drop-in node ``http.Agent`` — existing apps route their traffic through
+cueball pools by changing one constructor option
+(reference lib/agent.js:30-94, README.adoc:35-141). These modules are
+the Python-ecosystem analogues, built on the pluggable seams Python
+HTTP clients actually expose:
+
+- :mod:`cueball_tpu.integrations.httpx` —
+  ``httpx.AsyncBaseTransport`` backed by cueball ConnectionPools.
+- :mod:`cueball_tpu.integrations.aiohttp` —
+  ``aiohttp.BaseConnector`` backed by cueball ConnectionPools.
+
+Each submodule imports its host library at module import time (not at
+package import), so cueball_tpu itself never requires httpx/aiohttp.
+"""

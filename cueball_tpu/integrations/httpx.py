@@ -1,0 +1,311 @@
+"""httpx drop-in: route an ``httpx.AsyncClient`` through cueball pools.
+
+The reference's single biggest adoption property is that ``HttpAgent``
+is a drop-in node ``http.Agent``: an existing app adopts cueball by
+changing one constructor option, and every request it makes from then
+on rides pooled, service-discovered, health-checked connections
+(reference lib/agent.js:30-94; README.adoc:35-141 shows the one-line
+adoption). Python's HTTP clients don't share node's Agent seam; the
+seam httpx exposes is the transport. This module is therefore the
+faithful analogue::
+
+    import httpx
+    from cueball_tpu.integrations.httpx import CueballTransport
+
+    client = httpx.AsyncClient(transport=CueballTransport({
+        'spares': 2, 'maximum': 8,
+        'recovery': {'default': {'timeout': 2000, 'retries': 3,
+                                 'delay': 100, 'maxDelay': 2000}},
+    }))
+    r = await client.get('http://my-service.example/')   # pooled
+
+Lifecycle mapping (what reference lib/agent.js:275-396 does for node's
+request events, re-expressed for httpx's request/response model):
+
+- request start -> ``pool.claim()`` on the lazily-created pool for the
+  URL's (scheme, host, port); httpx's *pool* timeout bounds the claim.
+- response fully read on a keep-alive connection -> ``handle.release()``
+  (the reference's ``'free'`` -> ``releaseConn``).
+- close-delimited response, protocol error, or read timeout ->
+  ``handle.close()`` (the reference's ``'close'`` handler).
+- cancellation (``asyncio.CancelledError``) -> ``handle.close()`` (the
+  reference's ``'abort'`` -> ``claimHandle.cancel()``; a mid-request
+  cancel leaves the connection state unknown, so close not release).
+- claim failures surface as httpx transport errors so retry/error
+  handling written for stock httpx keeps working: ``ClaimTimeoutError``
+  -> ``httpx.PoolTimeout``; ``NoBackendsError`` / ``PoolFailedError`` /
+  ``PoolStoppingError`` -> ``httpx.ConnectError``.
+
+Health checking, dead-backend monitoring, CoDel shedding, DNS SRV/A
+discovery and the rest all come from the pools underneath — configure
+them with the same agent options the reference documents (``ping``,
+``pingInterval``, ``resolvers``, ``tcpKeepAliveInitialDelay``, TLS
+passthrough fields...).
+
+Request and response bodies are buffered (the pool hands out exclusive
+claims per request, so no interleaving is lost); apps that stream
+multi-GB bodies through httpx should keep a stock transport for those
+endpoints via httpx mounts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import httpx
+
+from .. import errors as mod_errors
+from ..agent import CueBallAgent, _read_response
+
+_SCHEME_PORT = {'http': 80, 'https': 443}
+
+
+class _TimeoutReader:
+    """StreamReader proxy applying httpx's read-timeout semantics: the
+    timeout bounds each individual read operation, not the whole
+    response (a steadily-streaming large body must not trip it)."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 timeout: float | None):
+        self._reader = reader
+        self._timeout = timeout
+
+    async def readline(self) -> bytes:
+        return await asyncio.wait_for(self._reader.readline(),
+                                      self._timeout)
+
+    async def readexactly(self, n: int) -> bytes:
+        # Chunk-wise, so the timeout bounds each arrival gap rather
+        # than the whole (possibly large) body.
+        if self._timeout is None:
+            return await self._reader.readexactly(n)
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = await asyncio.wait_for(
+                self._reader.read(n - len(buf)), self._timeout)
+            if not chunk:
+                raise asyncio.IncompleteReadError(bytes(buf), n)
+            buf.extend(chunk)
+        return bytes(buf)
+
+    async def read(self, n: int = -1) -> bytes:
+        if self._timeout is None:
+            return await self._reader.read(n)
+        if n >= 0:
+            return await asyncio.wait_for(self._reader.read(n),
+                                          self._timeout)
+        # read-to-EOF (close-delimited bodies): chunk-wise, like
+        # readexactly, so the timeout bounds each arrival gap.
+        buf = bytearray()
+        while True:
+            chunk = await asyncio.wait_for(
+                self._reader.read(65536), self._timeout)
+            if not chunk:
+                return bytes(buf)
+            buf.extend(chunk)
+
+
+def _classify_timeout(e: TimeoutError,
+                      read_timeout: float | None) -> httpx.TransportError:
+    """On Python >= 3.11 ``asyncio.TimeoutError`` IS the builtin
+    ``TimeoutError``, and an OS-level ETIMEDOUT (TCP retransmit
+    give-up, surfacing from drain() or a read) instantiates the same
+    class. Only a wait_for expiry — errno-less, and only armed when a
+    read timeout was configured — is httpx.ReadTimeout; the OS flavor
+    is a connection failure, httpx.ReadError."""
+    if read_timeout is not None and getattr(e, 'errno', None) is None:
+        return httpx.ReadTimeout('no data within %gs' % read_timeout)
+    return httpx.ReadError(str(e) or 'connection timed out')
+
+
+class CueballTransport(httpx.AsyncBaseTransport):
+    """``httpx.AsyncBaseTransport`` whose connections come from cueball
+    ConnectionPools (one pool per (scheme, host, port), created lazily
+    like reference lib/agent.js:105-211).
+
+    `options` are CueBallAgent options minus ``defaultPort`` (derived
+    from the URL scheme). Unlike the agent (which, like the reference,
+    requires ``recovery``), the transport defaults ``recovery`` to a
+    conservative policy ({timeout: 2000, retries: 3, delay: 100,
+    maxDelay: 2000}) and ``spares``/``maximum`` to 2/8, so that the
+    one-line adoption works with zero cueball-specific configuration.
+
+    For a host whose backends need a custom resolver (e.g. a static
+    list for failover), pre-create its pool exactly as reference
+    consumers do (lib/agent.js:464-488)::
+
+        transport.agent_for('http').create_pool('svc.local',
+            {'resolver': my_resolver})
+
+    A pool pre-created that way (keyed by bare host) serves any port
+    for that host — its resolver, not the URL, decides the backends.
+    Pools created lazily from URLs are keyed (host, port) and serve
+    only that port.
+    """
+
+    def __init__(self, options: dict | None = None):
+        opts = dict(options or {})
+        opts.setdefault('spares', 2)
+        opts.setdefault('maximum', 8)
+        if 'recovery' not in opts:
+            opts['recovery'] = {'default': {
+                'timeout': 2000, 'retries': 3,
+                'delay': 100, 'maxDelay': 2000}}
+        self._options = opts
+        self._agents: dict[str, CueBallAgent] = {}
+        # (scheme, host) pairs whose *bare-host* pool this transport
+        # created lazily from a default-port URL. A bare-host pool NOT
+        # in this set was pre-created by the app (create_pool) and may
+        # serve any port for its host; explicit-port pools need no
+        # tracking (their key already encodes the port).
+        self._lazy_bare_hosts: set[tuple[str, str]] = set()
+        self._closed = False
+
+    # -- pool plumbing ----------------------------------------------------
+
+    def agent_for(self, scheme: str) -> CueBallAgent:
+        """The underlying CueBallAgent for a scheme (created lazily);
+        exposed so apps can pre-create pools / read stats."""
+        if self._closed:
+            # Creating (or handing out) an agent after aclose() would
+            # leak pools nothing will ever stop.
+            raise httpx.TransportError('CueballTransport is closed')
+        agent = self._agents.get(scheme)
+        if agent is None:
+            opts = dict(self._options)
+            opts.setdefault('defaultPort', _SCHEME_PORT[scheme])
+            agent = CueBallAgent(opts, scheme)
+            self._agents[scheme] = agent
+        return agent
+
+    async def _claim(self, scheme: str, host: str, port: int,
+                     timeout_ms: float | None):
+        """Claim a pooled connection for (scheme, host, port).
+
+        The agent keys pools by bare host for reference parity
+        (lib/agent.js keys this.pools by hostname); URLs carry
+        explicit ports, so pools created here are keyed 'host:port'
+        unless the port is the scheme default. An app-pre-created
+        bare-host pool is preferred for its host whatever the URL
+        port (its resolver owns the backend choice); a *lazily*
+        created default-port pool is not consulted for other ports —
+        falling back to it would silently send a :8080 request to
+        port 80."""
+        agent = self.agent_for(scheme)
+        key = host if port == agent.default_port else \
+            '%s:%d' % (host, port)
+        pool = agent.pools.get(key)
+        if pool is None:
+            bare = agent.pools.get(host)
+            if bare is not None and \
+                    (scheme, host) not in self._lazy_bare_hosts:
+                pool = bare
+        if pool is None:
+            pool = agent._add_pool(host, {'port': port,
+                                          'poolKey': key})
+            if key == host:
+                self._lazy_bare_hosts.add((scheme, host))
+        claim_opts = {}
+        # A CoDel pool derives its own claim deadline and (like the
+        # reference, lib/pool.js:874-885) forbids an explicit one;
+        # httpx's default 5s pool timeout must not break such pools.
+        if timeout_ms is not None and not pool.codel_enabled():
+            claim_opts['timeout'] = timeout_ms
+        if agent.cba_err_on_empty is not None:
+            claim_opts['errorOnEmpty'] = agent.cba_err_on_empty
+        return await pool.claim(claim_opts)
+
+    # -- the transport contract -------------------------------------------
+
+    async def handle_async_request(self,
+                                   request: httpx.Request) -> httpx.Response:
+        if self._closed:
+            raise httpx.TransportError('CueballTransport is closed')
+        scheme = request.url.scheme
+        if scheme not in _SCHEME_PORT:
+            raise httpx.UnsupportedProtocol(
+                'CueballTransport handles http/https, not %r' % scheme)
+        host = request.url.host
+        port = request.url.port or _SCHEME_PORT[scheme]
+
+        timeouts = request.extensions.get('timeout', {}) or {}
+        pool_timeout = timeouts.get('pool')
+        read_timeout = timeouts.get('read')
+
+        body = await request.aread()
+        payload = self._serialize(request, body)
+
+        try:
+            handle, socket = await self._claim(
+                scheme, host, port,
+                pool_timeout * 1000.0 if pool_timeout is not None
+                else None)
+        except mod_errors.ClaimTimeoutError as e:
+            raise httpx.PoolTimeout(str(e)) from e
+        except (mod_errors.NoBackendsError,
+                mod_errors.PoolFailedError,
+                mod_errors.PoolStoppingError) as e:
+            raise httpx.ConnectError(str(e)) from e
+
+        try:
+            socket.writer.write(payload)
+            await socket.writer.drain()
+            resp, keep_alive = await _read_response(
+                _TimeoutReader(socket.reader, read_timeout),
+                request.method)
+        except asyncio.TimeoutError as e:
+            handle.close()
+            raise _classify_timeout(e, read_timeout) from e
+        except asyncio.CancelledError:
+            handle.close()
+            raise
+        except (ConnectionError, EOFError, OSError, ValueError) as e:
+            handle.close()
+            raise httpx.ReadError(str(e)) from e
+        except BaseException:
+            handle.close()
+            raise
+
+        if keep_alive:
+            handle.release()
+        else:
+            handle.close()
+
+        return httpx.Response(
+            status_code=resp.status,
+            headers=resp.raw_headers,
+            content=resp.body,
+            request=request,
+            extensions={'http_version': b'HTTP/1.1',
+                        'reason_phrase': resp.reason.encode('latin-1')})
+
+    @staticmethod
+    def _serialize(request: httpx.Request, body: bytes) -> bytes:
+        """One HTTP/1.1 request head + body, preserving httpx's header
+        order and duplicates. httpx frames unknown-length content as
+        chunked; the body is buffered here, so that framing is
+        rewritten as Content-Length."""
+        target = request.url.raw_path.decode('ascii')
+        lines = ['%s %s HTTP/1.1' % (request.method, target)]
+        saw_length = False
+        for name, value in request.headers.raw:
+            lname = name.lower()
+            if lname == b'transfer-encoding' and value.lower() == b'chunked':
+                continue
+            if lname == b'content-length':
+                saw_length = True
+            lines.append('%s: %s' % (name.decode('latin-1'),
+                                     value.decode('latin-1')))
+        if body and not saw_length:
+            lines.append('content-length: %d' % len(body))
+        return ('\r\n'.join(lines) + '\r\n\r\n').encode('latin-1') + body
+
+    async def aclose(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        agents = list(self._agents.values())
+        self._agents = {}
+        for agent in agents:
+            if not agent.is_stopped():
+                await agent.stop()

@@ -847,6 +847,13 @@ class ConnectionPool(FSM):
 
     getStats = get_stats
 
+    def codel_enabled(self) -> bool:
+        """Whether this pool derives claim deadlines from CoDel
+        (targetClaimDelay). Such pools reject an explicit claim
+        timeout (reference lib/pool.js:874-885); integration layers
+        use this to decide whether to forward one."""
+        return self.p_codel is not None
+
     # -- claim -----------------------------------------------------------
 
     def claim_cb(self, options=None, cb=None):

@@ -1,0 +1,118 @@
+"""One-line adoption: a stock httpx app on cueball pools.
+
+The reference's headline adoption story is that an existing node app
+switches to cueball by swapping its http.Agent for cueball's HttpAgent
+(reference README.adoc:35-141). This example is the Python analogue:
+an ordinary ``httpx.AsyncClient`` app whose ONLY cueball-specific line
+is the ``transport=`` argument — after that, every request rides
+pooled, health-checked, failover-capable connections.
+
+Self-contained: starts two tiny HTTP backends on localhost behind a
+static resolver, serves a batch of requests through the shared pool,
+kills one backend mid-run, and shows traffic continuing on the
+survivor.
+
+    python examples/httpx_drop_in.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import httpx
+
+from cueball_tpu.integrations.httpx import CueballTransport
+from cueball_tpu.resolver import StaticIpResolver
+
+
+class Backend:
+    """Tiny HTTP backend; kill() severs live sockets too, like a real
+    crash (keep-alive pool conns would otherwise outlive the
+    listener)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._writers = set()
+
+    async def start(self):
+        self.srv = await asyncio.start_server(
+            self._handle, '127.0.0.1', 0)
+        self.port = self.srv.sockets[0].getsockname()[1]
+        return self
+
+    async def _handle(self, reader, writer):
+        self._writers.add(writer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                if line in (b'\r\n', b'\n'):
+                    body = self.name.encode()
+                    writer.write(
+                        b'HTTP/1.1 200 OK\r\nContent-Length: %d\r\n'
+                        b'\r\n%s' % (len(body), body))
+                    await writer.drain()
+        except ConnectionError:
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    def kill(self):
+        self.srv.close()
+        for w in list(self._writers):
+            w.close()
+
+
+async def main():
+    srv_a = await Backend('backend-a').start()
+    srv_b = await Backend('backend-b').start()
+    port_a, port_b = srv_a.port, srv_b.port
+
+    transport = CueballTransport({
+        'spares': 2, 'maximum': 4,
+        'recovery': {'default': {'timeout': 500, 'retries': 2,
+                                 'delay': 50, 'maxDelay': 500}},
+    })
+    # Backends for the logical service name come from a resolver, as
+    # in any cueball deployment (DNS SRV in production; static here).
+    transport.agent_for('http').create_pool('api.internal', {
+        'resolver': StaticIpResolver({'backends': [
+            {'address': '127.0.0.1', 'port': port_a},
+            {'address': '127.0.0.1', 'port': port_b},
+        ]})})
+
+    # From here down this is a stock httpx app.
+    async with httpx.AsyncClient(transport=transport) as client:
+        served = {}
+        for _ in range(20):
+            r = await client.get('http://api.internal/')
+            served[r.text] = served.get(r.text, 0) + 1
+        print('20 requests pooled over %d backends: %s' %
+              (len(served), dict(sorted(served.items()))))
+
+        srv_a.kill()            # kill backend-a, live sockets and all
+
+        survivors = 0
+        deadline = asyncio.get_running_loop().time() + 8
+        while survivors < 10 and \
+                asyncio.get_running_loop().time() < deadline:
+            try:
+                r = await client.get('http://api.internal/')
+                if r.text == 'backend-b':
+                    survivors += 1
+            except httpx.TransportError:
+                await asyncio.sleep(0.05)
+        print('%d/10 requests served by the survivor after failover'
+              % survivors)
+
+    srv_b.kill()
+    print('clean shutdown')
+
+
+if __name__ == '__main__':
+    asyncio.run(main())

@@ -1,0 +1,393 @@
+"""httpx drop-in transport over real localhost servers: the ecosystem
+analogue of the reference's drop-in http.Agent property
+(reference lib/agent.js:30-94, README.adoc:35-141). The scenario
+battery mirrors tests/test_agent.py — pooling/reuse, failover when a
+backend dies, connection-refused fast-fail, 5xx ping eviction — but
+driven through a stock ``httpx.AsyncClient``."""
+
+import asyncio
+import ssl
+import time
+
+import httpx
+import pytest
+
+from cueball_tpu.integrations.httpx import CueballTransport
+from cueball_tpu.resolver import StaticIpResolver
+
+from conftest import run_async
+from test_agent import (MiniHttpServer, RECOVERY, FAST_RECOVERY,
+                        _make_self_signed)
+
+
+def test_one_line_adoption_pools_and_reuses():
+    async def t():
+        srv = await MiniHttpServer().start()
+        transport = CueballTransport({'spares': 2, 'maximum': 4,
+                                      'recovery': RECOVERY})
+        async with httpx.AsyncClient(transport=transport) as client:
+            for _ in range(6):
+                r = await asyncio.wait_for(
+                    client.get('http://127.0.0.1:%d/x' % srv.port), 5)
+                assert r.status_code == 200
+                assert r.text == 'hello from %d' % srv.port
+            agent = transport.agent_for('http')
+            pool = agent.pools.get('127.0.0.1:%d' % srv.port)
+            assert pool is not None, \
+                'lazily-created pool keyed by host:port'
+            stats = pool.get_stats()
+            # Sequential load rides keep-alive conns: busy(1)+spares(2),
+            # NOT one connection per request.
+            assert stats['totalConnections'] <= 3
+        # context-manager exit closed the transport: pools stopped
+        assert transport._closed
+        assert transport._agents == {}
+        srv.close()
+    run_async(t())
+
+
+def test_post_body_and_chunked_request_reframed():
+    async def t():
+        srv = await MiniHttpServer().start()
+        transport = CueballTransport({'recovery': RECOVERY})
+        async with httpx.AsyncClient(transport=transport) as client:
+            base = 'http://127.0.0.1:%d' % srv.port
+            r = await asyncio.wait_for(
+                client.post(base + '/submit', content=b'payload'), 5)
+            assert r.status_code == 200
+            assert ('POST', '/submit') in srv.requests
+
+            # Unknown-length content: httpx frames it chunked; the
+            # transport buffers and reframes as Content-Length, which
+            # the mini-server (which only reads Content-Length bodies,
+            # then answers on the same connection) proves by answering.
+            async def gen():
+                yield b'chunk1'
+                yield b'chunk2'
+            r = await asyncio.wait_for(
+                client.post(base + '/stream', content=gen()), 5)
+            assert r.status_code == 200
+            assert ('POST', '/stream') in srv.requests
+        srv.close()
+    run_async(t())
+
+
+def test_failover_when_backend_dies():
+    async def t():
+        srv1 = await MiniHttpServer().start()
+        srv2 = await MiniHttpServer().start()
+        resolver = StaticIpResolver({'backends': [
+            {'address': '127.0.0.1', 'port': srv1.port},
+            {'address': '127.0.0.1', 'port': srv2.port},
+        ]})
+        transport = CueballTransport({'spares': 2, 'maximum': 4,
+                                      'recovery': FAST_RECOVERY})
+        # Pre-create the pool with a custom resolver, exactly as
+        # reference consumers do (lib/agent.js:464-488).
+        transport.agent_for('http').create_pool(
+            'svc.local', {'resolver': resolver})
+        async with httpx.AsyncClient(transport=transport) as client:
+            seen = set()
+            for _ in range(8):
+                r = await asyncio.wait_for(
+                    client.get('http://svc.local/'), 5)
+                assert r.status_code == 200
+                seen.add(r.text)
+            assert len(seen) >= 1
+
+            # Kill backend 1 (listener AND live sockets); the pool must
+            # shift traffic to backend 2 without surfacing errors once
+            # it has re-established spares.
+            srv1.close()
+            deadline = time.monotonic() + 8
+            ok_from_2 = 0
+            while time.monotonic() < deadline and ok_from_2 < 3:
+                try:
+                    r = await asyncio.wait_for(
+                        client.get('http://svc.local/'), 5)
+                    if r.text == 'hello from %d' % srv2.port:
+                        ok_from_2 += 1
+                except (httpx.TransportError, asyncio.TimeoutError):
+                    await asyncio.sleep(0.05)
+            assert ok_from_2 >= 3, \
+                'no failover to surviving backend'
+        srv2.close()
+    run_async(t())
+
+
+def test_connection_refused_fast_fails_as_connect_error():
+    async def t():
+        transport = CueballTransport({'spares': 1, 'maximum': 2,
+                                      'recovery': FAST_RECOVERY})
+        async with httpx.AsyncClient(
+                transport=transport,
+                timeout=httpx.Timeout(5.0, pool=0.8)) as client:
+            t0 = time.monotonic()
+            with pytest.raises((httpx.ConnectError, httpx.PoolTimeout)):
+                await asyncio.wait_for(
+                    client.get('http://127.0.0.1:1/'), 5)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 1.5, 'fast-fail took %.2fs' % elapsed
+    run_async(t())
+
+
+def test_ping_5xx_evicts_then_recovers():
+    async def t():
+        srv = await MiniHttpServer().start()
+        transport = CueballTransport({
+            'spares': 1, 'maximum': 2, 'recovery': RECOVERY,
+            'ping': '/ping', 'pingInterval': 100})
+        async with httpx.AsyncClient(transport=transport) as client:
+            base = 'http://127.0.0.1:%d' % srv.port
+            r = await asyncio.wait_for(client.get(base + '/'), 5)
+            assert r.status_code == 200
+            await asyncio.sleep(0.6)
+            assert srv.ping_count >= 2, \
+                'pinger should run over pooled conns (got %d)' % \
+                srv.ping_count
+            # 5xx pings close connections; pool churns but recovers.
+            srv.fail_pings = True
+            await asyncio.sleep(0.5)
+            srv.fail_pings = False
+            r = await asyncio.wait_for(client.get(base + '/'), 5)
+            assert r.status_code == 200
+        srv.close()
+    run_async(t())
+
+
+def test_duplicate_set_cookie_headers_preserved():
+    async def t():
+        async def handler(reader, writer):
+            await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b'\r\n', b'\n', b''):
+                    break
+            writer.write(b'HTTP/1.1 200 OK\r\n'
+                         b'Set-Cookie: a=1\r\n'
+                         b'Set-Cookie: b=2\r\n'
+                         b'Content-Length: 2\r\n\r\nok')
+            await writer.drain()
+            writer.close()
+        srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+        port = srv.sockets[0].getsockname()[1]
+        transport = CueballTransport({'recovery': RECOVERY})
+        async with httpx.AsyncClient(transport=transport) as client:
+            r = await asyncio.wait_for(
+                client.get('http://127.0.0.1:%d/' % port), 5)
+            assert r.headers.get_list('set-cookie') == ['a=1', 'b=2']
+        srv.close()
+    run_async(t())
+
+
+async def _slow_server(delay_s):
+    async def handler(reader, writer):
+        line = await reader.readline()
+        while True:
+            h = await reader.readline()
+            if h in (b'\r\n', b'\n', b''):
+                break
+        if line:
+            await asyncio.sleep(delay_s)
+            writer.write(b'HTTP/1.1 200 OK\r\nContent-Length: 4\r\n'
+                         b'\r\nslow')
+            await writer.drain()
+        writer.close()
+    srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+    return srv, srv.sockets[0].getsockname()[1]
+
+
+def test_pool_exhaustion_maps_to_pool_timeout():
+    async def t():
+        srv, port = await _slow_server(2.0)
+        transport = CueballTransport({'spares': 1, 'maximum': 1,
+                                      'recovery': RECOVERY})
+        async with httpx.AsyncClient(
+                transport=transport,
+                timeout=httpx.Timeout(5.0, pool=0.3)) as client:
+            first = asyncio.ensure_future(
+                client.get('http://127.0.0.1:%d/' % port))
+            await asyncio.sleep(0.2)   # first request owns the 1 conn
+            with pytest.raises(httpx.PoolTimeout):
+                await client.get('http://127.0.0.1:%d/' % port)
+            first.cancel()
+            try:
+                await first
+            except (asyncio.CancelledError, httpx.TransportError):
+                pass
+        srv.close()
+    run_async(t())
+
+
+def test_read_timeout_closes_connection():
+    async def t():
+        srv, port = await _slow_server(2.0)
+        transport = CueballTransport({'spares': 1, 'maximum': 2,
+                                      'recovery': RECOVERY})
+        async with httpx.AsyncClient(
+                transport=transport,
+                timeout=httpx.Timeout(5.0, read=0.3)) as client:
+            with pytest.raises(httpx.ReadTimeout):
+                await client.get('http://127.0.0.1:%d/' % port)
+        srv.close()
+    run_async(t())
+
+
+def test_https_with_private_ca():
+    async def t():
+        key, cert = _make_self_signed()
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        srv = await MiniHttpServer().start(ssl_ctx=ctx)
+        transport = CueballTransport({'recovery': RECOVERY,
+                                      'ca': open(cert).read()})
+        async with httpx.AsyncClient(transport=transport) as client:
+            r = await asyncio.wait_for(
+                client.get('https://127.0.0.1:%d/secure' % srv.port),
+                10)
+            assert r.status_code == 200
+            assert r.text.startswith('hello from')
+        srv.close()
+    run_async(t())
+
+
+def test_unsupported_scheme_rejected():
+    async def t():
+        transport = CueballTransport({'recovery': RECOVERY})
+        req = httpx.Request('GET', 'ftp://example.com/')
+        with pytest.raises(httpx.UnsupportedProtocol):
+            await transport.handle_async_request(req)
+        await transport.aclose()
+    run_async(t())
+
+
+def test_explicit_port_never_reuses_default_port_pool():
+    async def t():
+        # A lazily-created default-port pool must NOT serve a URL with
+        # a different explicit port (that would silently send the
+        # request to the wrong backend); only app-pre-created pools
+        # may serve any port for their host.
+        srv_a = await MiniHttpServer().start()
+        srv_b = await MiniHttpServer().start()
+        transport = CueballTransport({'defaultPort': srv_a.port,
+                                      'recovery': RECOVERY})
+        async with httpx.AsyncClient(transport=transport) as client:
+            r = await asyncio.wait_for(
+                client.get('http://127.0.0.1:%d/' % srv_a.port), 5)
+            assert r.text == 'hello from %d' % srv_a.port
+            agent = transport.agent_for('http')
+            assert '127.0.0.1' in agent.pools   # bare key: default port
+            r = await asyncio.wait_for(
+                client.get('http://127.0.0.1:%d/' % srv_b.port), 5)
+            assert r.text == 'hello from %d' % srv_b.port, \
+                'explicit-port URL was routed to the default-port pool'
+            assert '127.0.0.1:%d' % srv_b.port in agent.pools
+        srv_a.close()
+        srv_b.close()
+    run_async(t())
+
+
+def test_read_timeout_is_per_read_not_whole_response():
+    async def t():
+        # A body that streams steadily — every gap under the read
+        # timeout, total duration over it — must succeed (stock httpx
+        # semantics: the read timeout bounds each socket read).
+        async def handler(reader, writer):
+            await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b'\r\n', b'\n', b''):
+                    break
+            writer.write(b'HTTP/1.1 200 OK\r\nContent-Length: 40\r\n'
+                         b'\r\n')
+            for _ in range(10):
+                await asyncio.sleep(0.12)
+                writer.write(b'flow')
+                await writer.drain()
+            writer.close()
+        srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+        port = srv.sockets[0].getsockname()[1]
+        transport = CueballTransport({'recovery': RECOVERY})
+        async with httpx.AsyncClient(
+                transport=transport,
+                timeout=httpx.Timeout(5.0, read=0.5)) as client:
+            t0 = time.monotonic()
+            r = await client.get('http://127.0.0.1:%d/' % port)
+            assert r.status_code == 200
+            assert r.content == b'flow' * 10
+            assert time.monotonic() - t0 > 1.0, \
+                'body should have streamed for >1s total'
+        srv.close()
+    run_async(t())
+
+
+def test_close_delimited_body_streams_past_read_timeout():
+    async def t():
+        # No Content-Length, no chunked framing: body is delimited by
+        # connection close (_read_response's read-to-EOF path). Steady
+        # streaming longer than the read timeout must still succeed.
+        async def handler(reader, writer):
+            await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b'\r\n', b'\n', b''):
+                    break
+            writer.write(b'HTTP/1.1 200 OK\r\nConnection: close\r\n'
+                         b'\r\n')
+            for _ in range(8):
+                await asyncio.sleep(0.12)
+                writer.write(b'part')
+                await writer.drain()
+            writer.close()
+        srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+        port = srv.sockets[0].getsockname()[1]
+        transport = CueballTransport({'recovery': RECOVERY})
+        async with httpx.AsyncClient(
+                transport=transport,
+                timeout=httpx.Timeout(5.0, read=0.5)) as client:
+            r = await client.get('http://127.0.0.1:%d/' % port)
+            assert r.status_code == 200
+            assert r.content == b'part' * 8
+        srv.close()
+    run_async(t())
+
+
+def test_timeout_classification_os_vs_wait_for():
+    import errno
+    from cueball_tpu.integrations.httpx import _classify_timeout
+    # wait_for expiry: errno-less TimeoutError while a read timeout is
+    # armed -> ReadTimeout.
+    e = asyncio.TimeoutError()
+    assert isinstance(_classify_timeout(e, 0.5), httpx.ReadTimeout)
+    # OS-level ETIMEDOUT (TCP retransmit give-up) is the same class on
+    # py>=3.11 but carries errno -> a connection failure, ReadError.
+    os_e = OSError(errno.ETIMEDOUT, 'Connection timed out')
+    assert isinstance(os_e, asyncio.TimeoutError)
+    assert isinstance(_classify_timeout(os_e, 0.5), httpx.ReadError)
+    # No read timeout configured: a TimeoutError cannot be a wait_for
+    # expiry -> ReadError, never '%g % None'.
+    assert isinstance(_classify_timeout(asyncio.TimeoutError(), None),
+                      httpx.ReadError)
+
+
+def test_agent_for_after_close_raises_not_leaks():
+    async def t():
+        transport = CueballTransport({'recovery': RECOVERY})
+        await transport.aclose()
+        # An agent created after aclose() would never be stopped; the
+        # transport must refuse instead (covers the aclose/in-flight
+        # request race).
+        with pytest.raises(httpx.TransportError):
+            transport.agent_for('http')
+    run_async(t())
+
+
+def test_closed_transport_refuses_requests():
+    async def t():
+        transport = CueballTransport({'recovery': RECOVERY})
+        await transport.aclose()
+        req = httpx.Request('GET', 'http://127.0.0.1:1/')
+        with pytest.raises(httpx.TransportError):
+            await transport.handle_async_request(req)
+        await transport.aclose()   # idempotent
+    run_async(t())
