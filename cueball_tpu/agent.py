@@ -31,7 +31,7 @@ from . import utils as mod_utils
 from .events import EventEmitter
 from .fsm import get_loop
 from .pool import ConnectionPool
-from .resolver import resolver_for_ip_or_domain
+from .resolver import pool_resolver
 
 # TLS fields passed through from agent options to the socket constructor
 # (reference lib/agent.js:96-97).
@@ -299,17 +299,10 @@ class CueBallAgent(EventEmitter):
         port = options.get('port') or self.default_port
         resolver = options.get('resolver')
         if resolver is None:
-            resolver = resolver_for_ip_or_domain({
-                'input': '%s:%d' % (host, port),
-                'resolverConfig': {
-                    'resolvers': self.resolvers,
-                    'service': self.service,
-                    'maxDNSConcurrency': 3,
-                    'recovery': self.cba_recovery,
-                    'log': self.log,
-                }})
-        if isinstance(resolver, Exception):
-            raise resolver
+            resolver = pool_resolver(
+                host, port, service=self.service,
+                recovery=self.cba_recovery, resolvers=self.resolvers,
+                log=self.log)
 
         pool_opts = {
             'domain': host,

@@ -1,0 +1,352 @@
+"""aiohttp drop-in: route a stock ``aiohttp.ClientSession`` through
+cueball pools.
+
+The second half of the ecosystem drop-in story (see
+:mod:`cueball_tpu.integrations.httpx` for the first): aiohttp's
+pluggable seam is the connector, so this module provides::
+
+    import aiohttp
+    from cueball_tpu.integrations.aiohttp import CueballConnector
+
+    session = aiohttp.ClientSession(connector=CueballConnector({
+        'spares': 2, 'maximum': 8,
+        'recovery': {'default': {'timeout': 2000, 'retries': 3,
+                                 'delay': 100, 'maxDelay': 2000}},
+    }))
+    async with session.get('http://my-service.example/') as r:  # pooled
+        ...
+
+Mapping of aiohttp's connector contract onto cueball (mirroring how
+reference lib/agent.js:275-396 maps node's request lifecycle onto
+claim handles):
+
+- ``connect(req, ...)`` -> ``pool.claim()`` on the pool for the
+  request's (host, port, is_ssl); the ClientTimeout.connect value
+  bounds the claim. The claimed cueball connection owns an aiohttp
+  ``ResponseHandler`` protocol, which is exactly what aiohttp drives
+  for the request/response cycle — parsing, streaming bodies and
+  chunked uploads all behave stock.
+- aiohttp releases a reusable connection -> ``handle.release()``; a
+  connection flagged ``should_close`` (or explicitly closed) ->
+  ``handle.close()``. The base connector's own keep-alive cache is
+  bypassed entirely — cueball is the sole pooler, so its spares
+  policy, backoff, dead-backend monitoring and rebalancing govern.
+- claim failures surface as aiohttp client errors so stock error
+  handling keeps working: ``ClaimTimeoutError`` ->
+  ``aiohttp.ConnectionTimeoutError``; ``NoBackendsError`` /
+  ``PoolFailedError`` / ``PoolStoppingError`` ->
+  ``aiohttp.ClientConnectionError``.
+
+Not supported through this connector: proxies and certificate
+fingerprint pinning (both raise ``ClientConnectionError``); use a
+stock connector for those endpoints.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl as mod_ssl
+
+import aiohttp
+from aiohttp.client_proto import ResponseHandler
+
+from .. import errors as mod_errors
+from ..events import EventEmitter
+from ..pool import ConnectionPool
+from ..resolver import pool_resolver
+
+_DEFAULT_RECOVERY = {'default': {'timeout': 2000, 'retries': 3,
+                                 'delay': 100, 'maxDelay': 2000}}
+
+
+class _WatchedHandler(ResponseHandler):
+    """ResponseHandler that reports connection loss to the owning
+    pooled connection even while it sits idle in the pool (same need
+    as agent._WatchedProtocol: a backend FIN must evict the idle
+    connection, not fester until the next claim)."""
+
+    def __init__(self, loop, owner):
+        super().__init__(loop)
+        self._cb_owner = owner
+
+    def connection_lost(self, exc):
+        super().connection_lost(exc)
+        self._cb_owner._on_lost(exc)
+
+
+class AioPooledConnection(EventEmitter):
+    """Cueball connection-interface object owning one aiohttp
+    ResponseHandler protocol (the constructSocket analogue,
+    reference lib/agent.js:146-197)."""
+
+    def __init__(self, backend: dict, ssl_ctx, server_hostname):
+        super().__init__()
+        self.backend = backend
+        self.proto: ResponseHandler | None = None
+        self.destroyed = False
+        self._ssl_ctx = ssl_ctx
+        self._server_hostname = server_hostname
+        self._task = asyncio.ensure_future(self._connect())
+
+    async def _connect(self):
+        try:
+            loop = asyncio.get_running_loop()
+            kwargs = {}
+            if self._ssl_ctx is not None:
+                kwargs['ssl'] = self._ssl_ctx
+                kwargs['server_hostname'] = self._server_hostname
+            _, proto = await loop.create_connection(
+                lambda: _WatchedHandler(loop, self),
+                self.backend['address'], self.backend['port'],
+                **kwargs)
+            self.proto = proto
+            self.emit('connect')
+        except (OSError, mod_ssl.SSLError) as e:
+            self.emit('error', e)
+        except asyncio.CancelledError:
+            pass
+
+    def _on_lost(self, exc):
+        if self.destroyed:
+            return
+        if exc is not None:
+            self.emit('error', exc)
+        else:
+            self.emit('close')
+
+    def destroy(self):
+        self.destroyed = True
+        if self.proto is not None:
+            self.proto.close()
+        elif not self._task.done():
+            self._task.cancel()
+
+    def unref(self):
+        pass
+
+    def ref(self):
+        pass
+
+
+class CueballConnector(aiohttp.BaseConnector):
+    """``aiohttp.BaseConnector`` whose connections come from cueball
+    ConnectionPools (one per (host, port, TLS settings), created
+    lazily — requests with different ``ssl`` arguments to the same
+    host get different pools, so an ``ssl=False`` request can never
+    be served an unverified connection pooled for a verified one, and
+    vice versa).
+
+    `options` are pool options (``spares``, ``maximum``,
+    ``recovery``, ``resolvers``, ``service``, ``log``, ...);
+    ``recovery`` defaults to a conservative policy and
+    ``spares``/``maximum`` to 2/8 so one-line adoption needs zero
+    cueball-specific configuration.
+
+    For a host whose backends need a custom resolver (failover over a
+    static list, SRV discovery under a different name...), pre-create
+    its pool::
+
+        connector.create_pool('svc.local', 80,
+                              resolver=my_resolver)
+
+    Must be constructed inside a running event loop (the aiohttp
+    convention for connectors and sessions alike).
+    """
+
+    def __init__(self, options: dict | None = None, **kwargs):
+        super().__init__(**kwargs)
+        opts = dict(options or {})
+        opts.setdefault('spares', 2)
+        opts.setdefault('maximum', 8)
+        opts.setdefault('recovery', _DEFAULT_RECOVERY)
+        self._cb_options = opts
+        self._cb_pools: dict[tuple, ConnectionPool] = {}
+        self._cb_resolvers: dict[tuple, object] = {}
+        self._cb_claims: dict[ResponseHandler, object] = {}
+
+    # -- pool plumbing ----------------------------------------------------
+
+    @staticmethod
+    def _ssl_key(sslobj):
+        """Normalize a ConnectionKey.ssl value into a hashable pool-key
+        component. Distinct TLS settings MUST map to distinct pools —
+        sharing would let an ssl=False request's pool serve unverified
+        connections to a later verified request."""
+        if sslobj is True or sslobj is None:
+            return 'default'
+        if sslobj is False:
+            return 'noverify'
+        if isinstance(sslobj, mod_ssl.SSLContext):
+            return sslobj          # keyed (and kept alive) by identity
+        raise aiohttp.ClientConnectionError(
+            'CueballConnector does not support ssl=%r '
+            '(fingerprint pinning needs a stock connector)' % (sslobj,))
+
+    def _ssl_context_for(self, key):
+        if not key.is_ssl:
+            return None, None
+        server_hostname = key.host
+        sslobj = key.ssl
+        if isinstance(sslobj, mod_ssl.SSLContext):
+            return sslobj, server_hostname
+        if sslobj is False:
+            ctx = mod_ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = mod_ssl.CERT_NONE
+            return ctx, server_hostname
+        return mod_ssl.create_default_context(), server_hostname
+
+    def create_pool(self, host: str, port: int, *, is_ssl: bool = False,
+                    resolver=None, ssl_ctx=None) -> ConnectionPool:
+        """Pre-create the pool for (host, port[, is_ssl]) with a custom
+        resolver (the create_pool analogue,
+        reference lib/agent.js:464-488). With ``ssl_ctx`` the pool
+        serves requests passing that same context as their ``ssl``;
+        otherwise (is_ssl) it serves default-verification requests."""
+        key = (host, port, is_ssl,
+               (ssl_ctx if ssl_ctx is not None else 'default')
+               if is_ssl else None)
+        if key in self._cb_pools:
+            raise RuntimeError(
+                'a pool already exists for %s:%d (ssl=%s)' %
+                (host, port, is_ssl))
+        return self._make_pool(key, host, port, resolver=resolver,
+                               ssl_ctx=ssl_ctx)
+
+    def get_pool(self, host: str, port: int, is_ssl: bool = False,
+                 sslobj=None) -> ConnectionPool | None:
+        key = (host, port, is_ssl,
+               self._ssl_key(sslobj) if is_ssl else None)
+        return self._cb_pools.get(key)
+
+    def _make_pool(self, key: tuple, host: str, port: int,
+                   resolver=None, ssl_ctx=None,
+                   server_hostname=None) -> ConnectionPool:
+        opts = self._cb_options
+        is_ssl = key[2]
+        if resolver is None:
+            resolver = pool_resolver(
+                host, port,
+                service=opts.get('service') or
+                ('_https._tcp' if is_ssl else '_http._tcp'),
+                recovery=opts['recovery'],
+                resolvers=opts.get('resolvers'),
+                log=opts.get('log'))
+
+        def construct(backend):
+            return AioPooledConnection(backend, ssl_ctx,
+                                       server_hostname or host)
+
+        pool_opts = {
+            'domain': host,
+            'resolver': resolver,
+            'constructor': construct,
+            'maximum': opts['maximum'],
+            'spares': opts['spares'],
+            'recovery': opts['recovery'],
+        }
+        for passthrough in ('log', 'collector', 'checker',
+                            'checkTimeout', 'targetClaimDelay',
+                            'maxChurnRate'):
+            if passthrough in opts:
+                pool_opts[passthrough] = opts[passthrough]
+        pool = ConnectionPool(pool_opts)
+        if resolver.is_in_state('stopped'):
+            resolver.start()
+        self._cb_pools[key] = pool
+        self._cb_resolvers[key] = resolver
+        return pool
+
+    # -- the connector contract -------------------------------------------
+
+    async def connect(self, req, traces, timeout):
+        """Claim a pooled connection and hand aiohttp its protocol
+        (replaces BaseConnector.connect: cueball is the sole pooler,
+        the base keep-alive cache is never used)."""
+        if self._closed:
+            raise aiohttp.ClientConnectionError('Connector is closed.')
+        if req.proxy:
+            raise aiohttp.ClientConnectionError(
+                'CueballConnector does not support proxies; mount a '
+                'stock connector for proxied endpoints')
+        ckey = req.connection_key
+        key = (ckey.host, ckey.port, ckey.is_ssl,
+               self._ssl_key(ckey.ssl) if ckey.is_ssl else None)
+        pool = self._cb_pools.get(key)
+        if pool is None:
+            ssl_ctx, server_hostname = self._ssl_context_for(ckey)
+            pool = self._make_pool(key, ckey.host, ckey.port,
+                                   ssl_ctx=ssl_ctx,
+                                   server_hostname=server_hostname)
+
+        claim_opts = {}
+        connect_timeout = getattr(timeout, 'connect', None)
+        if connect_timeout is not None and not pool.codel_enabled():
+            claim_opts['timeout'] = connect_timeout * 1000.0
+
+        if traces:
+            for trace in traces:
+                await trace.send_connection_create_start()
+        try:
+            handle, sock = await pool.claim(claim_opts)
+        except mod_errors.ClaimTimeoutError as e:
+            raise aiohttp.ConnectionTimeoutError(str(e)) from e
+        except (mod_errors.NoBackendsError,
+                mod_errors.PoolFailedError,
+                mod_errors.PoolStoppingError) as e:
+            raise aiohttp.ClientConnectionError(str(e)) from e
+        if traces:
+            for trace in traces:
+                await trace.send_connection_create_end()
+
+        proto = sock.proto
+        if self._closed or proto is None or not proto.is_connected():
+            handle.close()
+            raise aiohttp.ClientConnectionError(
+                'Connector is closed.' if self._closed else
+                'claimed connection is no longer connected')
+        self._cb_claims[proto] = handle
+        return aiohttp.connector.Connection(self, ckey, proto,
+                                            self._loop)
+
+    def _release(self, key, protocol, *, should_close: bool = False):
+        """aiohttp hands the connection back: map onto the claim
+        handle (reference 'free'/'close' handlers,
+        lib/agent.js:297-340)."""
+        handle = self._cb_claims.pop(protocol, None)
+        if handle is None:
+            return
+        if should_close or protocol.should_close:
+            handle.close()
+        else:
+            handle.release()
+
+    def _cb_reclaim(self):
+        for proto, handle in list(self._cb_claims.items()):
+            self._cb_claims.pop(proto, None)
+            if handle.is_in_state('claimed'):
+                handle.close()
+
+    def close(self, *, abort_ssl: bool = False):
+        """Stop every pool (and its resolver), reclaiming outstanding
+        claims, then run the base teardown."""
+        return self._loop.create_task(self._cb_close(abort_ssl))
+
+    async def _cb_close(self, abort_ssl: bool):
+        pools = list(self._cb_pools.values())
+        resolvers = list(self._cb_resolvers.values())
+        self._cb_pools = {}
+        self._cb_resolvers = {}
+        self._cb_reclaim()
+        for pool in pools:
+            if not (pool.is_in_state('stopping') or
+                    pool.is_in_state('stopped')):
+                pool.stop()
+        for pool in pools:
+            while not pool.is_in_state('stopped'):
+                self._cb_reclaim()
+                await asyncio.sleep(0.01)
+        for res in resolvers:
+            if not res.is_in_state('stopped'):
+                res.stop()
+        await super().close(abort_ssl=abort_ssl)

@@ -315,6 +315,27 @@ def resolver_for_ip_or_domain(args: dict):
     return spec['cons'](spec['mergedConfig'])
 
 
+def pool_resolver(host: str, port: int, *, service: str,
+                  recovery: dict, resolvers=None, log=None,
+                  max_dns_concurrency: int = 3):
+    """The default per-pool resolver, constructed the way the agent
+    does it (reference lib/agent.js:117-139) — shared by the agent and
+    the httpx/aiohttp integration layers so resolver configuration has
+    one owner. Raises (rather than returns) on invalid host input."""
+    res = resolver_for_ip_or_domain({
+        'input': '%s:%d' % (host, port),
+        'resolverConfig': {
+            'resolvers': resolvers,
+            'service': service,
+            'maxDNSConcurrency': max_dns_concurrency,
+            'recovery': recovery,
+            'log': log,
+        }})
+    if isinstance(res, Exception):
+        raise res
+    return res
+
+
 resolverForIpOrDomain = resolver_for_ip_or_domain
 configForIpOrDomain = config_for_ip_or_domain
 parseIpOrDomain = parse_ip_or_domain

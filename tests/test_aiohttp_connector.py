@@ -1,0 +1,266 @@
+"""aiohttp drop-in connector over real localhost servers: the second
+half of the ecosystem drop-in (reference lib/agent.js:30-94 adoption
+property), driven through a stock ``aiohttp.ClientSession``."""
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+
+from cueball_tpu.integrations.aiohttp import CueballConnector
+from cueball_tpu.resolver import StaticIpResolver
+
+from conftest import run_async
+from test_agent import MiniHttpServer, RECOVERY, FAST_RECOVERY
+
+
+def test_one_line_adoption_pools_and_reuses():
+    async def t():
+        srv = await MiniHttpServer().start()
+        connector = CueballConnector({'spares': 2, 'maximum': 4,
+                                      'recovery': RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            for _ in range(6):
+                async with s.get('http://127.0.0.1:%d/x'
+                                 % srv.port) as r:
+                    assert r.status == 200
+                    assert await r.text() == \
+                        'hello from %d' % srv.port
+            pool = connector.get_pool('127.0.0.1', srv.port)
+            assert pool is not None
+            stats = pool.get_stats()
+            # Keep-alive reuse: busy(1)+spares(2), NOT one conn per
+            # request.
+            assert stats['totalConnections'] <= 3
+        srv.close()
+    run_async(t())
+
+
+def test_post_body_roundtrip():
+    async def t():
+        srv = await MiniHttpServer().start()
+        connector = CueballConnector({'recovery': RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            async with s.post('http://127.0.0.1:%d/submit' % srv.port,
+                              data=b'payload') as r:
+                assert r.status == 200
+            assert ('POST', '/submit') in srv.requests
+        srv.close()
+    run_async(t())
+
+
+def test_failover_when_backend_dies():
+    async def t():
+        srv1 = await MiniHttpServer().start()
+        srv2 = await MiniHttpServer().start()
+        resolver = StaticIpResolver({'backends': [
+            {'address': '127.0.0.1', 'port': srv1.port},
+            {'address': '127.0.0.1', 'port': srv2.port},
+        ]})
+        connector = CueballConnector({'spares': 2, 'maximum': 4,
+                                      'recovery': FAST_RECOVERY})
+        connector.create_pool('svc.local', 80, resolver=resolver)
+        async with aiohttp.ClientSession(connector=connector) as s:
+            for _ in range(6):
+                async with s.get('http://svc.local/') as r:
+                    assert r.status == 200
+            srv1.close()
+            deadline = time.monotonic() + 8
+            ok_from_2 = 0
+            while time.monotonic() < deadline and ok_from_2 < 3:
+                try:
+                    async with s.get('http://svc.local/') as r:
+                        if await r.text() == \
+                                'hello from %d' % srv2.port:
+                            ok_from_2 += 1
+                except aiohttp.ClientError:
+                    await asyncio.sleep(0.05)
+            assert ok_from_2 >= 3, 'no failover to survivor'
+        srv2.close()
+    run_async(t())
+
+
+def test_connection_refused_fast_fails_as_client_error():
+    async def t():
+        connector = CueballConnector({'spares': 1, 'maximum': 2,
+                                      'recovery': FAST_RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            t0 = time.monotonic()
+            with pytest.raises(aiohttp.ClientConnectionError):
+                async with s.get('http://127.0.0.1:1/',
+                                 timeout=aiohttp.ClientTimeout(
+                                     total=5, connect=0.8)):
+                    pass
+            assert time.monotonic() - t0 < 1.5
+    run_async(t())
+
+
+def test_pool_exhaustion_maps_to_connection_timeout():
+    async def t():
+        async def handler(reader, writer):
+            await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b'\r\n', b'\n', b''):
+                    break
+            await asyncio.sleep(2.0)
+            writer.write(b'HTTP/1.1 200 OK\r\nContent-Length: 4\r\n'
+                         b'\r\nslow')
+            await writer.drain()
+            writer.close()
+        srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+        port = srv.sockets[0].getsockname()[1]
+        connector = CueballConnector({'spares': 1, 'maximum': 1,
+                                      'recovery': RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            first = asyncio.ensure_future(
+                s.get('http://127.0.0.1:%d/' % port))
+            await asyncio.sleep(0.2)
+            with pytest.raises(aiohttp.ConnectionTimeoutError):
+                async with s.get('http://127.0.0.1:%d/' % port,
+                                 timeout=aiohttp.ClientTimeout(
+                                     total=5, connect=0.3)):
+                    pass
+            first.cancel()
+            try:
+                await first
+            except (asyncio.CancelledError, aiohttp.ClientError):
+                pass
+        srv.close()
+    run_async(t())
+
+
+def test_connection_close_response_not_reused():
+    async def t():
+        conns = []
+
+        async def handler(reader, writer):
+            conns.append(writer)
+            await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b'\r\n', b'\n', b''):
+                    break
+            writer.write(b'HTTP/1.1 200 OK\r\nConnection: close\r\n'
+                         b'Content-Length: 2\r\n\r\nok')
+            await writer.drain()
+            writer.close()
+        srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+        port = srv.sockets[0].getsockname()[1]
+        connector = CueballConnector({'spares': 1, 'maximum': 2,
+                                      'recovery': RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            for _ in range(2):
+                async with s.get('http://127.0.0.1:%d/' % port) as r:
+                    assert await r.text() == 'ok'
+            # Connection: close must tear down the claimed conn, not
+            # recycle it: each request used a fresh server-side conn.
+            assert len(conns) >= 2
+        srv.close()
+    run_async(t())
+
+
+def test_chunked_response_streams_through():
+    async def t():
+        async def handler(reader, writer):
+            await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b'\r\n', b'\n', b''):
+                    break
+            writer.write(b'HTTP/1.1 200 OK\r\n'
+                         b'Transfer-Encoding: chunked\r\n\r\n')
+            for part in (b'alpha', b'beta', b'gamma'):
+                writer.write(b'%x\r\n%s\r\n' % (len(part), part))
+                await writer.drain()
+                await asyncio.sleep(0.02)
+            writer.write(b'0\r\n\r\n')
+            await writer.drain()
+        srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+        port = srv.sockets[0].getsockname()[1]
+        connector = CueballConnector({'recovery': RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            async with s.get('http://127.0.0.1:%d/' % port) as r:
+                assert await r.text() == 'alphabetagamma'
+            # chunked + keep-alive: the conn went back to the pool
+            pool = connector.get_pool('127.0.0.1', port)
+            assert pool.get_stats()['totalConnections'] >= 1
+        srv.close()
+    run_async(t())
+
+
+def test_distinct_tls_settings_get_distinct_pools():
+    async def t():
+        # An ssl=False (no-verify) request must never share a pool —
+        # and therefore connections — with a default-verification
+        # request to the same host:port.
+        import ssl as mod_ssl
+        from test_agent import _make_self_signed
+        key, cert = _make_self_signed()
+        ctx = mod_ssl.SSLContext(mod_ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        srv = await MiniHttpServer().start(ssl_ctx=ctx)
+        connector = CueballConnector({'spares': 1, 'maximum': 2,
+                                      'recovery': FAST_RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            url = 'https://127.0.0.1:%d/' % srv.port
+            async with s.get(url, ssl=False) as r:
+                assert r.status == 200
+            # Default verification must NOT ride the no-verify pool:
+            # the self-signed cert fails, from a separate pool.
+            with pytest.raises(aiohttp.ClientConnectionError):
+                async with s.get(url):
+                    pass
+            assert connector.get_pool('127.0.0.1', srv.port,
+                                      is_ssl=True,
+                                      sslobj=False) is not None
+            assert connector.get_pool('127.0.0.1', srv.port,
+                                      is_ssl=True,
+                                      sslobj=True) is not None
+            # ...and the no-verify pool still works afterwards.
+            async with s.get(url, ssl=False) as r:
+                assert r.status == 200
+        srv.close()
+    run_async(t())
+
+
+def test_https_pool_derives_srv_service():
+    async def t():
+        connector = CueballConnector({'recovery': RECOVERY})
+        pool = connector._make_pool(('svc.example', 443, True,
+                                     'default'),
+                                    'svc.example', 443)
+        resolver = connector._cb_resolvers[('svc.example', 443, True,
+                                            'default')]
+        assert resolver.r_fsm.r_service == '_https._tcp', \
+            'https pools must discover _https._tcp, not _http._tcp'
+        pool.stop()
+        while not pool.is_in_state('stopped'):
+            await asyncio.sleep(0.01)
+        await connector.close()
+    run_async(t())
+
+
+def test_duplicate_create_pool_raises():
+    async def t():
+        connector = CueballConnector({'recovery': RECOVERY})
+        resolver = StaticIpResolver({'backends': [
+            {'address': '127.0.0.1', 'port': 1}]})
+        connector.create_pool('svc', 80, resolver=resolver)
+        with pytest.raises(RuntimeError, match='already exists'):
+            connector.create_pool('svc', 80, resolver=resolver)
+        await connector.close()
+    run_async(t())
+
+
+def test_proxy_rejected():
+    async def t():
+        connector = CueballConnector({'recovery': RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            with pytest.raises(aiohttp.ClientConnectionError,
+                               match='proxies'):
+                async with s.get('http://127.0.0.1:1/',
+                                 proxy='http://127.0.0.1:2/'):
+                    pass
+    run_async(t())

@@ -354,16 +354,20 @@ def test_close_delimited_body_streams_past_read_timeout():
 
 def test_timeout_classification_os_vs_wait_for():
     import errno
+    import sys
     from cueball_tpu.integrations.httpx import _classify_timeout
     # wait_for expiry: errno-less TimeoutError while a read timeout is
     # armed -> ReadTimeout.
     e = asyncio.TimeoutError()
     assert isinstance(_classify_timeout(e, 0.5), httpx.ReadTimeout)
-    # OS-level ETIMEDOUT (TCP retransmit give-up) is the same class on
-    # py>=3.11 but carries errno -> a connection failure, ReadError.
+    # OS-level ETIMEDOUT (TCP retransmit give-up) carries errno -> a
+    # connection failure, ReadError. Only on py>=3.11 is it the same
+    # class as asyncio.TimeoutError (on 3.10 the OSError except clause
+    # catches it first, with the same ReadError outcome).
     os_e = OSError(errno.ETIMEDOUT, 'Connection timed out')
-    assert isinstance(os_e, asyncio.TimeoutError)
-    assert isinstance(_classify_timeout(os_e, 0.5), httpx.ReadError)
+    if sys.version_info >= (3, 11):
+        assert isinstance(os_e, asyncio.TimeoutError)
+        assert isinstance(_classify_timeout(os_e, 0.5), httpx.ReadError)
     # No read timeout configured: a TimeoutError cannot be a wait_for
     # expiry -> ReadError, never '%g % None'.
     assert isinstance(_classify_timeout(asyncio.TimeoutError(), None),
