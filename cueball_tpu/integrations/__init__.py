@@ -15,3 +15,18 @@ HTTP clients actually expose:
 Each submodule imports its host library at module import time (not at
 package import), so cueball_tpu itself never requires httpx/aiohttp.
 """
+
+
+def apply_default_pool_policy(options: dict | None) -> dict:
+    """The shared zero-config pool policy for drop-in integrations:
+    unlike the agent (which, like the reference, requires `recovery`),
+    one-line adoption must work with no cueball-specific configuration,
+    so both integrations default to 2 spares, 8 maximum, and a
+    conservative recovery."""
+    opts = dict(options or {})
+    opts.setdefault('spares', 2)
+    opts.setdefault('maximum', 8)
+    opts.setdefault('recovery', {'default': {
+        'timeout': 2000, 'retries': 3,
+        'delay': 100, 'maxDelay': 2000}})
+    return opts

@@ -54,9 +54,7 @@ from .. import errors as mod_errors
 from ..events import EventEmitter
 from ..pool import ConnectionPool
 from ..resolver import pool_resolver
-
-_DEFAULT_RECOVERY = {'default': {'timeout': 2000, 'retries': 3,
-                                 'delay': 100, 'maxDelay': 2000}}
+from . import apply_default_pool_policy
 
 
 class _WatchedHandler(ResponseHandler):
@@ -155,11 +153,7 @@ class CueballConnector(aiohttp.BaseConnector):
 
     def __init__(self, options: dict | None = None, **kwargs):
         super().__init__(**kwargs)
-        opts = dict(options or {})
-        opts.setdefault('spares', 2)
-        opts.setdefault('maximum', 8)
-        opts.setdefault('recovery', _DEFAULT_RECOVERY)
-        self._cb_options = opts
+        self._cb_options = apply_default_pool_policy(options)
         self._cb_pools: dict[tuple, ConnectionPool] = {}
         self._cb_resolvers: dict[tuple, object] = {}
         self._cb_claims: dict[ResponseHandler, object] = {}

@@ -56,6 +56,7 @@ import httpx
 
 from .. import errors as mod_errors
 from ..agent import CueBallAgent, _read_response
+from . import apply_default_pool_policy
 
 _SCHEME_PORT = {'http': 80, 'https': 443}
 
@@ -144,14 +145,7 @@ class CueballTransport(httpx.AsyncBaseTransport):
     """
 
     def __init__(self, options: dict | None = None):
-        opts = dict(options or {})
-        opts.setdefault('spares', 2)
-        opts.setdefault('maximum', 8)
-        if 'recovery' not in opts:
-            opts['recovery'] = {'default': {
-                'timeout': 2000, 'retries': 3,
-                'delay': 100, 'maxDelay': 2000}}
-        self._options = opts
+        self._options = apply_default_pool_policy(options)
         self._agents: dict[str, CueBallAgent] = {}
         # (scheme, host) pairs whose *bare-host* pool this transport
         # created lazily from a default-port URL. A bare-host pool NOT

@@ -1,0 +1,257 @@
+"""The quality gates themselves are load-bearing (every commit runs
+them; the coverage number the repo advertises comes from cbcov), so
+each cblint rule and the cbcov tracer's accounting get seeded-fixture
+tests here — the analogue of the reference vendoring jsl/jsstyle as
+first-class deps (reference Makefile:33-41)."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, ROOT / 'tools' / ('%s.py' % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+cblint = _load('cblint')
+cbcov = _load('cbcov')
+
+
+# ---------------------------------------------------------------------------
+# cblint: every rule, one seeded violation each
+
+def _codes(tmp_path, source: bytes, name='seed.py'):
+    p = tmp_path / name
+    p.write_bytes(source)
+    return {v.code for v in cblint.lint_file(p)}
+
+
+CASES = [
+    ('S001', b'x = 1  # %s\n' % (b'y' * 80)),
+    ('S002', b'x = 1 \n'),
+    ('S003', b'if True:\n\tx = 1\n'),
+    ('S004', b'x = 1'),
+    ('S005', b'x = 1\r\n'),
+    ('S006', b'x = 1\n\n\n'),
+    ('S007', b'if True:\n  x = 1\n'),
+    ('S008', b'x = 1; y = 2\n'),
+    ('S009', b'z = (1,2)\n'),
+    ('S010', b'x=1\n'),
+    ('S010', b'def f(a, b):\n    return a<b\n'),
+    ('S010', b'def f(x)->int:\n    return x\n'),
+    ('S011', b'if True: x = 1\n'),
+    ('S011', b'def f(): return 1\n'),
+    ('S011', b'try: x = 1\nexcept Exception:\n    pass\n'),
+    ('S011', b'if True:\n    x = 1\nelse: x = 2\n'),
+    ('S011', b'try:\n    x = 1\nfinally: x = 2\n'),
+    ('C100', b'def f(:\n'),
+    ('C101', b'import os\nx = 1\n'),
+    ('C102', b'def f(a=[]):\n    return a\n'),
+    ('C103', b'try:\n    x = 1\nexcept:\n    pass\n'),
+    ('C104', b'y = 1\nx = y is "lit"\n'),
+    ('C105', b'x = f"no placeholders"\n'),
+    ('C107', b'assert (True, "msg")\n'),
+    ('C108', b'd = {1: "a", 1: "b"}\n'),
+]
+
+
+@pytest.mark.parametrize('code,src', CASES,
+                         ids=['%s-%d' % (c, i)
+                              for i, (c, _) in enumerate(CASES)])
+def test_rule_catches_seeded_violation(tmp_path, code, src):
+    assert code in _codes(tmp_path, src), \
+        '%s not raised for %r' % (code, src)
+
+
+def test_exit_codes_and_output(tmp_path, capsys):
+    bad = tmp_path / 'bad.py'
+    bad.write_bytes(b'import os\nx=1;y = 2 \n')
+    assert cblint.main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    for code in ('S002', 'S008', 'S010', 'C101'):
+        assert code in out
+    good = tmp_path / 'good.py'
+    good.write_bytes(b'x = 1\n')
+    assert cblint.main([str(good)]) == 0
+    assert cblint.main([]) == 2          # no targets
+
+
+def test_cli_subprocess_gate(tmp_path):
+    bad = tmp_path / 'bad.py'
+    bad.write_bytes(b'def f(a,b):\n  return a<b\n')
+    r = subprocess.run(
+        [sys.executable, str(ROOT / 'tools' / 'cblint.py'), str(bad)],
+        capture_output=True, text=True)
+    assert r.returncode == 1
+    assert 'S007' in r.stdout and 'S009' in r.stdout \
+        and 'S010' in r.stdout
+
+
+def test_suppression_comment_silences(tmp_path):
+    src = (b'x=1  # cblint: ignore\n'
+           b'import os  # cblint: ignore\n')
+    assert _codes(tmp_path, src) == set()
+
+
+def test_clean_pep8_file_passes(tmp_path):
+    src = (b'"""Doc."""\n\n'
+           b'import math\n\n\n'
+           b'def hypot(a, b=0, *, scale=1.0):\n'
+           b'    values = [a, b]\n'
+           b'    if scale != 1.0:\n'
+           b'        values = [v * scale for v in values]\n'
+           b'    return math.hypot(*values)\n')
+    assert _codes(tmp_path, src) == set()
+
+
+def test_singleton_is_comparisons_allowed(tmp_path):
+    src = b'y = 1\nx = y is None\nz = y is not True\n'
+    assert 'C104' not in _codes(tmp_path, src)
+
+
+def test_keyword_defaults_need_no_operator_spaces(tmp_path):
+    # '=' inside brackets is a kwarg/default — exempt from S010.
+    src = b'def f(a=1, b=2):\n    return f(a=3, b=4)\n'
+    assert 'S010' not in _codes(tmp_path, src)
+
+
+def test_lambda_defaults_exempt_from_s010(tmp_path):
+    # Lambda parameter defaults sit at bracket depth 0 but are still
+    # defaults: `lambda x=1: x` is PEP8-correct as written.
+    src = (b'f = lambda x=1: x\n'
+           b'g = sorted([], key=lambda v=0: v)\n')
+    assert 'S010' not in _codes(tmp_path, src)
+
+
+def test_clean_clause_keywords_pass(tmp_path):
+    src = (b'try:\n'
+           b'    x = 1\n'
+           b'except Exception:\n'
+           b'    x = 2\n'
+           b'else:\n'
+           b'    x = 3\n'
+           b'finally:\n'
+           b'    x = 4\n'
+           b'y = 1 if x else 2\n')
+    assert 'S011' not in _codes(tmp_path, src)
+
+
+# ---------------------------------------------------------------------------
+# cbcov: tracer accounting, merge, pragma, gate
+
+MOD = '''\
+def covered():
+    a = 1
+    return a
+
+
+def uncovered():
+    b = 2
+    return b
+
+
+X = covered()
+'''
+
+_DRIVER = '''\
+import sys
+sys.path.insert(0, %(tools)r)
+sys.path.insert(0, %(tmp)r)
+import cbcov
+cbcov.start(%(tmp)r)
+import mod
+%(extra)s
+pct = cbcov.report()
+print('PCT=%%.4f' %% pct)
+'''
+
+
+def _run_cov(tmp_path, extra='', env_extra=None):
+    (tmp_path / 'mod.py').write_text(MOD)
+    env = dict(os.environ)
+    env.pop('CBCOV', None)
+    env.update(env_extra or {})
+    code = _DRIVER % {'tools': str(ROOT / 'tools'),
+                      'tmp': str(tmp_path), 'extra': extra}
+    r = subprocess.run([sys.executable, '-c', code],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    for line in r.stdout.splitlines():
+        if line.startswith('PCT='):
+            return float(line.split('=')[1]), r.stdout
+    raise AssertionError('no PCT in output:\n%s' % r.stdout)
+
+
+def test_executable_line_universe(tmp_path):
+    p = tmp_path / 'mod.py'
+    p.write_text(MOD)
+    lines = cbcov._executable_lines(str(p))
+    # def covered, a=1, return a, def uncovered, b=2, return b, X=...
+    assert lines == {1, 2, 3, 6, 7, 8, 11}
+
+
+def test_exact_percentage_import_only(tmp_path):
+    # Importing mod executes both def statements, covered()'s body and
+    # X — 5 of the 7 executable lines: 71.43%.
+    pct, out = _run_cov(tmp_path)
+    assert abs(pct - 100.0 * 5 / 7) < 0.01, out
+    assert '7-8' in out, 'missing-line ranges should name 7-8'
+
+
+def test_exact_percentage_full(tmp_path):
+    pct, _ = _run_cov(tmp_path, extra='mod.uncovered()')
+    assert pct == 100.0
+
+
+def test_merge_across_two_runs(tmp_path):
+    merge = str(tmp_path / 'hits.json')
+    pct1, _ = _run_cov(tmp_path, env_extra={'CBCOV_MERGE': merge})
+    assert abs(pct1 - 100.0 * 5 / 7) < 0.01
+    with open(merge, encoding='utf-8') as f:
+        saved = json.load(f)
+    assert sorted(saved[str(tmp_path / 'mod.py')]) == [1, 2, 3, 6, 11]
+    # Second run covers the complement; the union is 100%.
+    pct2, _ = _run_cov(tmp_path, extra='mod.uncovered()',
+                       env_extra={'CBCOV_MERGE': merge})
+    assert pct2 == 100.0
+
+
+def test_pragma_no_cover_excludes_block(tmp_path):
+    p = tmp_path / 'mod.py'
+    p.write_text('def skipped():  # pragma: no cover\n'
+                 '    a = 1\n'
+                 '    return a\n'
+                 'X = 1\n')
+    assert cbcov._executable_lines(str(p)) == {4}
+
+
+def test_check_gate_exit_codes(tmp_path):
+    pf = tmp_path / 'pct.txt'
+    pf.write_text('89.9\n')
+    tool = str(ROOT / 'tools' / 'cbcov.py')
+    r = subprocess.run([sys.executable, tool, 'check', str(pf), '90'],
+                       capture_output=True, text=True)
+    assert r.returncode == 2 and 'FAIL' in r.stderr
+    pf.write_text('94.3\n')
+    r = subprocess.run([sys.executable, tool, 'check', str(pf), '90'],
+                       capture_output=True, text=True)
+    assert r.returncode == 0
+
+
+def test_ranges_formatting():
+    assert cbcov._ranges(set()) == ''
+    assert cbcov._ranges({1, 2, 3, 7, 9, 10}) == '1-3,7,9-10'
+    long = set(range(1, 60, 2))
+    s = cbcov._ranges(long, limit=5)
+    assert s.endswith('...')

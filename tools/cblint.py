@@ -18,11 +18,24 @@ Usage: cblint.py [paths...]   (directories are walked for *.py)
 from __future__ import annotations
 
 import ast
+import io
 import sys
+import tokenize
 from pathlib import Path
 
 MAX_LINE = 79
 SUPPRESS = '# cblint: ignore'
+INDENT_STEP = 4
+
+# Operators that unambiguously require surrounding whitespace (the
+# jsstyle operator-spacing analogue). Plain '=' is handled separately
+# (only at bracket depth 0, where it cannot be a keyword argument or
+# default); arithmetic operators are excluded entirely — telling a
+# binary '-' from a unary one line-wise is exactly the false-positive
+# swamp jsstyle itself struggled with.
+_SPACED_OPS = {'==', '!=', '<=', '>=', '<', '>', '+=', '-=', '*=',
+               '/=', '//=', '%=', '**=', '|=', '&=', '^=', '>>=',
+               '<<=', ':=', '->'}
 
 
 class Violation:
@@ -61,6 +74,99 @@ def check_style(path: str, text: str) -> list[Violation]:
     if text.endswith('\n\n\n'):
         out.append(Violation(path, len(lines), 'S006',
                              'multiple blank lines at end of file'))
+    out.extend(check_token_style(path, text, lines))
+    return out
+
+
+def check_token_style(path: str, text: str,
+                      lines: list[str]) -> list[Violation]:
+    """Tokenizer-based style rules (the jsstyle indentation/spacing
+    half): S007 indent steps of exactly 4, S008 no multi-statement
+    lines, S009 space after comma, S010 spaces around comparison /
+    augmented-assignment / arrow / top-level '=' operators."""
+    sup = {i for i, line in enumerate(lines, 1)
+           if line.endswith(SUPPRESS)}
+    try:
+        toks = list(tokenize.generate_tokens(
+            io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []     # C100 reports the parse failure
+    out = []
+
+    def add(row, code, msg):
+        if row not in sup:
+            out.append(Violation(path, row, code, msg))
+
+    depth = 0
+    indents = [0]
+    # Lambda headers may carry parameter defaults at bracket depth 0
+    # (`lambda x=1: x` is PEP8-correct): '=' is exempt from S010 until
+    # the lambda's own ':' closes the header.
+    lambda_depths: list[int] = []
+    # Clause keywords whose inline bodies the AST pass can't see
+    # (ast.Try/If give no lineno for else/finally clauses): watched
+    # token-wise for S011.
+    clause_kw = None        # (keyword, row) awaiting its ':' at depth 0
+    clause_colon = None     # (keyword, row) after the ':', awaiting code
+    at_line_start = True
+    for ttype, s, (srow, scol), (erow, ecol), line in toks:
+        if ttype == tokenize.INDENT:
+            new = len(s.expandtabs())
+            step = new - indents[-1]
+            if step != INDENT_STEP:
+                add(srow, 'S007',
+                    'indent step of %d (expected %d)' %
+                    (step, INDENT_STEP))
+            indents.append(new)
+            continue
+        if ttype == tokenize.DEDENT:
+            if len(indents) > 1:
+                indents.pop()
+            continue
+        if ttype in (tokenize.NEWLINE, tokenize.NL):
+            at_line_start = True
+            clause_kw = clause_colon = None
+            continue
+        if ttype == tokenize.COMMENT:
+            continue
+        if clause_colon is not None and srow == clause_colon[1]:
+            add(srow, 'S011',
+                'statement body on the same line as its '
+                "'%s' header" % clause_colon[0])
+            clause_colon = None
+        if at_line_start:
+            at_line_start = False
+            if ttype == tokenize.NAME and \
+                    s in ('try', 'else', 'finally'):
+                clause_kw = (s, srow)
+        if ttype == tokenize.NAME and s == 'lambda':
+            lambda_depths.append(depth)
+        elif ttype == tokenize.OP:
+            if s in '([{':
+                depth += 1
+            elif s in ')]}':
+                depth -= 1
+            elif s == ':':
+                if lambda_depths and depth == lambda_depths[-1]:
+                    lambda_depths.pop()
+                elif clause_kw is not None and depth == 0:
+                    clause_colon = clause_kw
+                    clause_kw = None
+            elif s == ';':
+                add(srow, 'S008',
+                    'multiple statements on one line (semicolon)')
+            elif s == ',':
+                rest = line[ecol:]
+                if rest and rest[0] not in ' \t)]}\n\r':
+                    add(srow, 'S009', 'missing space after comma')
+            elif s in _SPACED_OPS or \
+                    (s == '=' and depth == 0 and not lambda_depths):
+                before = line[scol - 1:scol]
+                after = line[ecol:ecol + 1]
+                if before not in ('', ' ', '\t') or \
+                        after not in ('', ' ', '\t'):
+                    add(srow, 'S010',
+                        "missing space around '%s'" % s)
     return out
 
 
@@ -112,10 +218,40 @@ class _CorrectnessVisitor(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node):
         self._check_defaults(node)
+        self._check_inline_body(node)
         self.generic_visit(node)
 
     def visit_AsyncFunctionDef(self, node):
         self._check_defaults(node)
+        self._check_inline_body(node)
+        self.generic_visit(node)
+
+    def visit_If(self, node):
+        self._check_inline_body(node)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._check_inline_body(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFor(self, node):
+        self._check_inline_body(node)
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_inline_body(node)
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        self._check_inline_body(node)
+        self.generic_visit(node)
+
+    def visit_AsyncWith(self, node):
+        self._check_inline_body(node)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        self._check_inline_body(node)
         self.generic_visit(node)
 
     def _check_defaults(self, node):
@@ -131,7 +267,17 @@ class _CorrectnessVisitor(ast.NodeVisitor):
             self._add(node, 'C103',
                       'bare except: (catches SystemExit/KeyboardInterrupt;'
                       ' use "except Exception" or narrower)')
+        self._check_inline_body(node)
         self.generic_visit(node)
+
+    def _check_inline_body(self, node):
+        """S011 (jsstyle one-statement-per-line): a compound
+        statement's body belongs on its own line, not after the
+        colon."""
+        body = getattr(node, 'body', None)
+        if body and body[0].lineno == node.lineno:
+            self._add(node, 'S011',
+                      'statement body on the same line as its header')
 
     def visit_Compare(self, node):
         for op, comp in zip(node.ops, node.comparators):
@@ -207,7 +353,10 @@ def check_correctness(path: str, text: str) -> list[Violation]:
 
 
 def lint_file(path: Path) -> list[Violation]:
-    text = path.read_text(encoding='utf-8')
+    # newline='' keeps \r\n intact — universal-newline translation
+    # would silently blind the CRLF rule (S005).
+    with open(path, encoding='utf-8', newline='') as f:
+        text = f.read()
     return check_style(str(path), text) + \
         check_correctness(str(path), text)
 
