@@ -148,7 +148,11 @@ async def bench_codel_tracking():
     return sum(errors) / len(errors)
 
 
-CLAIM_OPS_PER_TRIAL = 4000
+# 8000 ops ≈ 0.55 s/trial: r4 diagnosis showed residual trial-to-trial
+# spread tracks involuntary context switches (host preemptions, see
+# claim_release_trial_diags); longer trials dilute single preemption
+# events, which at 4000 ops were worth ~2% each.
+CLAIM_OPS_PER_TRIAL = 8000
 CLAIM_TRIALS = 10
 
 
@@ -209,7 +213,7 @@ async def bench_claim_throughput():
     return statistics.mean(rates), statistics.stdev(rates), rates, diags
 
 
-QUEUED_OPS_PER_TRIAL = 4000
+QUEUED_OPS_PER_TRIAL = 8000
 QUEUED_OUTSTANDING = 32
 
 

@@ -55,6 +55,7 @@ CASES = [
     ('S011', b'try: x = 1\nexcept Exception:\n    pass\n'),
     ('S011', b'if True:\n    x = 1\nelse: x = 2\n'),
     ('S011', b'try:\n    x = 1\nfinally: x = 2\n'),
+    ('S011', b'match 1:\n    case 1: x = 1\n'),
     ('C100', b'def f(:\n'),
     ('C101', b'import os\nx = 1\n'),
     ('C102', b'def f(a=[]):\n    return a\n'),
@@ -134,6 +135,12 @@ def test_lambda_defaults_exempt_from_s010(tmp_path):
     assert 'S010' not in _codes(tmp_path, src)
 
 
+def test_wrapped_operator_at_line_end_allowed(tmp_path):
+    # A spaced operator may legally end a wrapped physical line.
+    src = b'x = (1 ==\n     2)\n'
+    assert 'S010' not in _codes(tmp_path, src)
+
+
 def test_clean_clause_keywords_pass(tmp_path):
     src = (b'try:\n'
            b'    x = 1\n'
@@ -177,6 +184,11 @@ print('PCT=%%.4f' %% pct)
 '''
 
 
+needs_monitoring = pytest.mark.skipif(
+    sys.version_info < (3, 12),
+    reason='cbcov uses PEP 669 sys.monitoring (3.12+)')
+
+
 def _run_cov(tmp_path, extra='', env_extra=None):
     (tmp_path / 'mod.py').write_text(MOD)
     env = dict(os.environ)
@@ -201,6 +213,7 @@ def test_executable_line_universe(tmp_path):
     assert lines == {1, 2, 3, 6, 7, 8, 11}
 
 
+@needs_monitoring
 def test_exact_percentage_import_only(tmp_path):
     # Importing mod executes both def statements, covered()'s body and
     # X — 5 of the 7 executable lines: 71.43%.
@@ -209,11 +222,13 @@ def test_exact_percentage_import_only(tmp_path):
     assert '7-8' in out, 'missing-line ranges should name 7-8'
 
 
+@needs_monitoring
 def test_exact_percentage_full(tmp_path):
     pct, _ = _run_cov(tmp_path, extra='mod.uncovered()')
     assert pct == 100.0
 
 
+@needs_monitoring
 def test_merge_across_two_runs(tmp_path):
     merge = str(tmp_path / 'hits.json')
     pct1, _ = _run_cov(tmp_path, env_extra={'CBCOV_MERGE': merge})

@@ -163,8 +163,10 @@ def check_token_style(path: str, text: str,
                     (s == '=' and depth == 0 and not lambda_depths):
                 before = line[scol - 1:scol]
                 after = line[ecol:ecol + 1]
+                # '\n'/'\r' allowed after: the operator may end a
+                # wrapped physical line (`x = (1 ==\n     2)`).
                 if before not in ('', ' ', '\t') or \
-                        after not in ('', ' ', '\t'):
+                        after not in ('', ' ', '\t', '\n', '\r'):
                     add(srow, 'S010',
                         "missing space around '%s'" % s)
     return out
@@ -252,6 +254,16 @@ class _CorrectnessVisitor(ast.NodeVisitor):
 
     def visit_ClassDef(self, node):
         self._check_inline_body(node)
+        self.generic_visit(node)
+
+    def visit_Match(self, node):
+        self._check_inline_body(node)
+        for case in node.cases:
+            # match_case has no lineno of its own; its pattern does.
+            if case.body and case.body[0].lineno == case.pattern.lineno:
+                self._add(case.pattern, 'S011',
+                          'statement body on the same line as its '
+                          'header')
         self.generic_visit(node)
 
     def _check_defaults(self, node):
