@@ -69,6 +69,11 @@ class FleetSampler:
     - capacity: initial row capacity (default 8; grows by doubling)
     - collector: a metrics Collector to publish cueball_fleet_* gauges
     - record: keep a per-tick history of inputs/outputs (for tests)
+    - actuate: push each tick's batched FIR output back into the
+      sampled pools (receive_fleet_advisory). Default OFF. A pool
+      only *uses* the advisory if it was itself constructed with
+      fleetActuation=True — both ends opt in, so turning the sampler
+      flag on over a fleet of stock pools changes nothing.
     """
 
     def __init__(self, options: dict | None = None):
@@ -79,9 +84,11 @@ class FleetSampler:
         self.fs_capacity = options.get('capacity') or 8
         self.fs_collector: 'Collector | None' = options.get('collector')
         self.fs_record = bool(options.get('record'))
+        self.fs_actuate = bool(options.get('actuate'))
 
         self.fs_epoch = mod_utils.current_millis()
         self.fs_rows: dict[str, int] = {}      # pool uuid -> row
+        self.fs_row_ticks: dict[int, int] = {}  # row -> ticks since reset
         self.fs_free: list[int] = list(range(self.fs_capacity))
         self.fs_pending_reset: set[int] = set()
         self.fs_state = None                   # FleetState (lazy)
@@ -148,6 +155,7 @@ class FleetSampler:
             row = self.fs_free.pop(0)
             self.fs_rows[uuid] = row
             self.fs_pending_reset.add(row)
+            self.fs_row_ticks[row] = 0
 
     # -- gathering -------------------------------------------------------
 
@@ -268,6 +276,27 @@ class FleetSampler:
                 'drop': bool(out_np['drop'][row]),
                 'retry_backoff': float(out_np['retry_backoff'][row]),
             }
+        if self.fs_actuate:
+            # Close the loop: hand each pool its batched decision.
+            # The pool stores it unconditionally but consults it only
+            # under its own fleetActuation flag (+freshness TTL).
+            # Warm-up gate: a row's filter starts zeroed on (re)assign,
+            # so for the first `taps` ticks its output under-reads the
+            # history the pool's own converged filter still holds —
+            # pushing it would collapse the shrink clamp after a
+            # sampler restart. Only a fully-populated window (which by
+            # the parity laws equals the per-pool filter fed the same
+            # samples) is advisory-grade.
+            for uuid, (row, g) in gathered.items():
+                ticks = self.fs_row_ticks.get(row, 0) + 1
+                self.fs_row_ticks[row] = ticks
+                if ticks < self.fs_taps:
+                    continue
+                receive = getattr(pools[uuid],
+                                  'receive_fleet_advisory', None)
+                if receive is not None:
+                    receive(float(out_np['filtered'][row]), abs_now)
+
         record = {'tick': self.fs_ticks, 'now_ms': now,
                   'fleet': fleet_np, 'pools': per_pool}
         self.fs_latest = record

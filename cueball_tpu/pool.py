@@ -55,6 +55,11 @@ LP_INT = round(1000 / LP_RATE)
 # consulted at dequeue sites. See docs/internals.md (CoDel section).
 CODEL_PACE = 10
 
+# Fleet-actuation advisory freshness bound (ms): ~5 sampler ticks at
+# the default 200 ms cadence. Older advisories are ignored and the
+# pool's own filter governs again.
+FLEET_ADVISORY_TTL = 1000
+
 
 def gen_taps(count: int, tc: float) -> list[float]:
     """Generate normalized EMA filter taps (reference lib/pool.js:50-76).
@@ -244,6 +249,16 @@ class ConnectionPool(FSM):
         self.p_last_rebal_clamped = False
         self.p_rate_delay_timer = None
 
+        # Fleet actuation (opt-in, default OFF): when enabled AND a
+        # fresh advisory has arrived from a FleetSampler({'actuate':
+        # True}), the rebalance shrink clamp consults the batched
+        # TPU-computed FIR value instead of the local p_lpf. The laws
+        # are identical (tests/test_sampler.py parity), so behavior
+        # matches; the flag exists so the default path never depends
+        # on a sampler being alive.
+        self.p_fleet_actuation = bool(options.get('fleetActuation'))
+        self.p_fleet_advisory: tuple[float, float] | None = None
+
         # Low-pass filter sampling at 5 Hz
         # (reference lib/pool.js:249-262).
         self.p_lp_emitter = EventEmitter()
@@ -268,6 +283,27 @@ class ConnectionPool(FSM):
         self.p_lpf.put(self.lp_load_sample())
         if self.p_last_rebal_clamped:
             self.rebalance()
+
+    def receive_fleet_advisory(self, filtered: float,
+                               at_ms: float | None = None) -> None:
+        """Store the fleet sampler's batched FIR output for this pool.
+        Called every sampler tick when actuation is on; consulted by
+        _rebalance only if this pool opted in via fleetActuation."""
+        self.p_fleet_advisory = (
+            float(filtered),
+            at_ms if at_ms is not None else mod_utils.current_millis())
+
+    def _shrink_floor(self) -> float:
+        """The low-pass load figure the shrink clamp uses: the fleet
+        advisory when actuation is on and the advisory is fresh
+        (within FLEET_ADVISORY_TTL), else the local filter. Falling
+        back — never blocking — on a stale advisory means a stopped
+        or wedged sampler degrades to exactly the stock behavior."""
+        if self.p_fleet_actuation and self.p_fleet_advisory is not None:
+            val, at = self.p_fleet_advisory
+            if mod_utils.current_millis() - at <= FLEET_ADVISORY_TTL:
+                return val
+        return self.p_lpf.get()
 
     def _incr_counter(self, counter: str) -> None:
         mod_utils.update_error_metrics(
@@ -622,8 +658,9 @@ class ConnectionPool(FSM):
         target = busy + extras + self.p_spares
 
         # Clamp shrinking against the low-pass-filtered recent load
-        # (reference lib/pool.js:577-592).
-        min_ = math.ceil(self.p_lpf.get())
+        # (reference lib/pool.js:577-592); the figure comes from the
+        # fleet advisory when actuation is enabled (_shrink_floor).
+        min_ = math.ceil(self._shrink_floor())
         if target < min_ * 1.05:
             target = min_
             self.p_last_rebal_clamped = True

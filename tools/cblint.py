@@ -257,7 +257,8 @@ class _CorrectnessVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_Match(self, node):
-        self._check_inline_body(node)
+        # (no _check_inline_body: `match x: case ...` cannot parse, so
+        # only the per-case bodies can be inline)
         for case in node.cases:
             # match_case has no lineno of its own; its pattern does.
             if case.body and case.body[0].lineno == case.pattern.lineno:
