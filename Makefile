@@ -4,7 +4,7 @@ PYTHON ?= python3
 LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
-.PHONY: test check bench dryrun coverage native ci
+.PHONY: test check bench dryrun coverage native ci docs
 
 native:
 	$(PYTHON) native/build.py
@@ -37,6 +37,12 @@ dryrun:
 # environment ships no coverage.py/pytest-cov). Runs the suite on both
 # cores (each shadows the other's Python lines), merges the hit sets,
 # and fails under 90%.
+# Docs pipeline (reference Makefile:62-72 ghdocs analogue): gate on
+# broken links/anchors, then render the static HTML site.
+docs:
+	$(PYTHON) tools/cbdocs.py check docs README.md
+	$(PYTHON) tools/cbdocs.py html docs/_site docs README.md
+
 coverage:
 	rm -f .cbcov_hits .cbcov_pct
 	CBCOV=1 CBCOV_MERGE=.cbcov_hits $(PYTHON) -m pytest tests/ -q

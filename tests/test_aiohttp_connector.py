@@ -264,3 +264,97 @@ def test_proxy_rejected():
                                  proxy='http://127.0.0.1:2/'):
                     pass
     run_async(t())
+
+
+def test_custom_ssl_context_keys_and_verifies():
+    async def t():
+        import ssl as mod_ssl
+        from test_agent import _make_self_signed
+        key, cert = _make_self_signed()
+        srv_ctx = mod_ssl.SSLContext(mod_ssl.PROTOCOL_TLS_SERVER)
+        srv_ctx.load_cert_chain(cert, key)
+        srv = await MiniHttpServer().start(ssl_ctx=srv_ctx)
+
+        client_ctx = mod_ssl.create_default_context(cafile=cert)
+        client_ctx.check_hostname = False
+        connector = CueballConnector({'recovery': RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            url = 'https://127.0.0.1:%d/' % srv.port
+            async with s.get(url, ssl=client_ctx) as r:
+                assert r.status == 200
+            # The context object itself is the pool key.
+            assert connector.get_pool('127.0.0.1', srv.port,
+                                      is_ssl=True,
+                                      sslobj=client_ctx) is not None
+        srv.close()
+    run_async(t())
+
+
+def test_fingerprint_pinning_rejected():
+    async def t():
+        connector = CueballConnector({'recovery': RECOVERY})
+        with pytest.raises(aiohttp.ClientConnectionError,
+                           match='fingerprint'):
+            connector._ssl_key(object())
+        await connector.close()
+    run_async(t())
+
+
+def test_connect_after_close_refused():
+    async def t():
+        connector = CueballConnector({'recovery': RECOVERY})
+        session = aiohttp.ClientSession(connector=connector)
+        await session.close()
+        with pytest.raises((aiohttp.ClientConnectionError,
+                            RuntimeError)):
+            async with session.get('http://127.0.0.1:1/'):
+                pass
+    run_async(t())
+
+
+def test_close_reclaims_outstanding_claim():
+    async def t():
+        async def handler(reader, writer):
+            await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b'\r\n', b'\n', b''):
+                    break
+            # Headers + first chunk, then stall: the response stays
+            # incomplete so the claim stays outstanding.
+            writer.write(b'HTTP/1.1 200 OK\r\n'
+                         b'Transfer-Encoding: chunked\r\n\r\n'
+                         b'4\r\npart\r\n')
+            await writer.drain()
+            await asyncio.sleep(30)
+        srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+        port = srv.sockets[0].getsockname()[1]
+        connector = CueballConnector({'spares': 1, 'maximum': 2,
+                                      'recovery': RECOVERY})
+        session = aiohttp.ClientSession(connector=connector)
+        r = await session.get('http://127.0.0.1:%d/' % port)
+        assert len(connector._cb_claims) == 1
+        # close() must reclaim the claimed handle or the pool can
+        # never reach 'stopped'.
+        await asyncio.wait_for(session.close(), 5)
+        assert connector._cb_claims == {}
+        r.close()
+        srv.close()
+    run_async(t())
+
+
+def test_destroy_before_connect_cancels():
+    async def t():
+        from cueball_tpu.integrations.aiohttp import AioPooledConnection
+        # A backend that never accepts: destroy() while the connect
+        # task is in flight must cancel it without error events.
+        conn = AioPooledConnection(
+            {'address': '240.0.0.1', 'port': 9}, None, None)
+        errors = []
+        conn.on('error', errors.append)
+        await asyncio.sleep(0)
+        conn.destroy()
+        await asyncio.sleep(0.05)
+        assert conn.proto is None
+        assert errors == []
+    run_async(t())

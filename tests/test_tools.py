@@ -270,3 +270,92 @@ def test_ranges_formatting():
     long = set(range(1, 60, 2))
     s = cbcov._ranges(long, limit=5)
     assert s.endswith('...')
+
+
+# ---------------------------------------------------------------------------
+# cbdocs: the docs link gate + renderer (reference Makefile:62-72
+# ghdocs analogue)
+
+cbdocs = _load('cbdocs')
+
+
+def test_docs_check_passes_on_repo_docs():
+    assert cbdocs.check([str(ROOT / 'docs'),
+                         str(ROOT / 'README.md')]) == 0
+
+
+def test_docs_check_catches_broken_link_and_anchor(tmp_path, capsys):
+    (tmp_path / 'a.md').write_text(
+        '# Title\n\nSee [b](b.md) and [gone](missing.md) and '
+        '[bad](b.md#no-such-heading).\n')
+    (tmp_path / 'b.md').write_text('# B Doc\n\nHello.\n')
+    assert cbdocs.check([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert 'missing.md' in out and 'no-such-heading' in out
+    assert out.count('broken') >= 2
+
+
+def test_docs_anchor_slugs_github_style(tmp_path):
+    (tmp_path / 'a.md').write_text(
+        '# Hello, World!\n## Hello, World!\n## `code` & stuff\n\n'
+        '[one](#hello-world) [two](#hello-world-1) '
+        '[three](#code--stuff)\n')
+    assert cbdocs.check([str(tmp_path)]) == 0
+
+
+def test_docs_html_renders_site(tmp_path):
+    (tmp_path / 'a.md').write_text(
+        '# Title\n\nPara with [link](b.md#b-doc) and `code`.\n\n'
+        '```python\nx = 1\n```\n\n| h | i |\n|---|---|\n| 1 | 2 |\n\n'
+        '- item one\n- item two\n')
+    (tmp_path / 'b.md').write_text('# B Doc\n\nHello.\n')
+    out = tmp_path / 'site'
+    assert cbdocs.build_html(str(out), [str(tmp_path)]) == 0
+    a = (out / 'a.html').read_text()
+    assert '<h1 id="title">' in a
+    assert '<a href="b.html#b-doc">' in a        # .md -> .html
+    assert '<pre><code>' in a and '<table>' in a and '<li>' in a
+    assert (out / 'b.html').exists()
+
+
+def test_docs_cli_gate(tmp_path):
+    (tmp_path / 'bad.md').write_text('[x](nope.md)\n')
+    r = subprocess.run(
+        [sys.executable, str(ROOT / 'tools' / 'cbdocs.py'), 'check',
+         str(tmp_path)],
+        capture_output=True, text=True)
+    assert r.returncode == 1 and 'broken link' in r.stdout
+    r = subprocess.run(
+        [sys.executable, str(ROOT / 'tools' / 'cbdocs.py')],
+        capture_output=True, text=True)
+    assert r.returncode == 2
+
+
+def test_docs_check_lazy_external_anchor_no_crash(tmp_path, capsys):
+    # An anchored link into a file OUTSIDE the scanned set is scanned
+    # lazily; that must not break the iteration (and resolves/flags
+    # correctly).
+    sub = tmp_path / 'docs'
+    sub.mkdir()
+    (tmp_path / 'README.md').write_text('# Top Head\n\nHello.\n')
+    (sub / 'a.md').write_text(
+        '[ok](../README.md#top-head) [bad](../README.md#nope)\n')
+    assert cbdocs.check([str(sub)]) == 1
+    out = capsys.readouterr().out
+    assert 'nope' in out and 'top-head' not in out
+
+
+def test_docs_html_mirrors_tree_for_relative_links(tmp_path):
+    # In-repo shape: docs/index.md links ../README.md; the rendered
+    # site must keep that link working (mirror the source tree, no
+    # flattening/stem collisions).
+    sub = tmp_path / 'docs'
+    sub.mkdir()
+    (tmp_path / 'README.md').write_text('# Top\n\nHi.\n')
+    (sub / 'index.md').write_text('# Index\n\n[up](../README.md)\n')
+    out = tmp_path / 'site'
+    assert cbdocs.build_html(str(out),
+                             [str(sub), str(tmp_path / 'README.md')]) == 0
+    idx = (out / 'docs' / 'index.html').read_text()
+    assert '<a href="../README.html">' in idx
+    assert (out / 'README.html').exists()

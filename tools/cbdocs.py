@@ -1,0 +1,254 @@
+#!/usr/bin/env python3
+"""cbdocs — the docs build/publish pipeline.
+
+The reference's Makefile has a ghdocs pipeline that renders its docs
+and publishes them to GitHub Pages (reference Makefile:62-72, via the
+Manta build tooling). The markdown here needs no build step to *read*,
+so this tool supplies the two things that pipeline actually provided:
+
+1. a gate — every relative link and #anchor across the doc set must
+   resolve (`cbdocs.py check docs README.md`; exit 1 on a broken
+   link, wired into `make docs`), and
+2. a renderer — `cbdocs.py html <outdir> docs README.md` emits a
+   self-contained static HTML site (stdlib only, like the vendored
+   lint/coverage tools) ready to publish to any static host.
+
+Anchor slugs follow GitHub's algorithm (lowercase, strip punctuation,
+spaces to dashes, -N suffix on duplicates) so links that work on the
+repo page work in the rendered site and vice versa.
+"""
+
+from __future__ import annotations
+
+import html
+import re
+import sys
+from pathlib import Path
+
+_LINK_RE = re.compile(r'(?<!!)\[([^\]]+)\]\(([^)\s]+)\)')
+_HEADING_RE = re.compile(r'^(#{1,6})\s+(.*)$')
+_CODE_FENCE = re.compile(r'^(```|~~~)')
+
+
+def slugify(heading: str, seen: dict[str, int]) -> str:
+    """GitHub anchor slug: lowercase, drop non-word chars except
+    spaces/dashes, spaces to dashes, -N for duplicates."""
+    s = re.sub(r'[`*_]', '', heading.strip()).lower()
+    s = re.sub(r'[^\w\- ]', '', s)
+    s = s.replace(' ', '-')
+    n = seen.get(s)
+    seen[s] = (n or 0) + 1
+    return s if n is None else '%s-%d' % (s, n)
+
+
+def scan_doc(path: Path) -> tuple[list[str], list[tuple[int, str]]]:
+    """Return (anchors, links) for one markdown file; links are
+    (lineno, target) for relative targets only (http(s) skipped —
+    zero-egress environments can't verify them)."""
+    anchors: list[str] = []
+    links: list[tuple[int, str]] = []
+    seen: dict[str, int] = {}
+    in_code = False
+    for i, line in enumerate(path.read_text(encoding='utf-8')
+                             .split('\n'), 1):
+        if _CODE_FENCE.match(line.strip()):
+            in_code = not in_code
+            continue
+        if in_code:
+            continue
+        m = _HEADING_RE.match(line)
+        if m:
+            anchors.append(slugify(m.group(2), seen))
+        for lm in _LINK_RE.finditer(line):
+            target = lm.group(2)
+            if target.startswith(('http://', 'https://', 'mailto:')):
+                continue
+            links.append((i, target))
+    return anchors, links
+
+
+def collect(paths: list[str]) -> dict[Path, tuple[list, list]]:
+    docs: dict[Path, tuple[list, list]] = {}
+    for a in paths:
+        p = Path(a)
+        targets = sorted(p.rglob('*.md')) if p.is_dir() else [p]
+        for t in targets:
+            docs[t.resolve()] = scan_doc(t)
+    return docs
+
+
+def check(paths: list[str]) -> int:
+    docs = collect(paths)
+    errors = []
+    # Snapshot: anchored links into files outside the scanned set are
+    # lazily scanned into `docs` below, which must not break the walk.
+    for path, (_anchors, links) in list(docs.items()):
+        for lineno, target in links:
+            base, _, frag = target.partition('#')
+            dest = path if base == '' else \
+                (path.parent / base).resolve()
+            if base != '' and not dest.exists():
+                errors.append('%s:%d: broken link: %s (no such file)'
+                              % (path, lineno, target))
+                continue
+            if frag:
+                dest_anchors = docs.get(dest)
+                if dest_anchors is None:
+                    if dest.suffix == '.md':
+                        dest_anchors = scan_doc(dest)
+                        docs[dest] = dest_anchors
+                    else:
+                        continue     # anchors into non-md: unchecked
+                if frag not in dest_anchors[0]:
+                    errors.append(
+                        '%s:%d: broken anchor: %s (no heading "#%s" '
+                        'in %s)' % (path, lineno, target, frag,
+                                    dest.name))
+    for e in errors:
+        print(e)
+    if errors:
+        print('cbdocs: %d broken link(s)' % len(errors))
+        return 1
+    print('cbdocs: %d doc(s), all links resolve' % len(docs))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Minimal renderer (stdlib only)
+
+_CSS = '''body{max-width:46rem;margin:2rem auto;padding:0 1rem;
+font:16px/1.6 system-ui,sans-serif;color:#1a1a2e}
+pre{background:#f6f8fa;padding:.8rem;overflow-x:auto;border-radius:6px}
+code{background:#f6f8fa;padding:.1em .3em;border-radius:4px;
+font-size:.92em}pre code{padding:0}
+table{border-collapse:collapse}td,th{border:1px solid #d0d7de;
+padding:.3em .6em}h1,h2{border-bottom:1px solid #d8dee4;
+padding-bottom:.3rem}a{color:#0b57d0}'''
+
+
+def _inline(text: str) -> str:
+    text = html.escape(text, quote=False)
+    text = re.sub(r'`([^`]+)`', r'<code>\1</code>', text)
+    text = re.sub(r'\*\*([^*]+)\*\*', r'<strong>\1</strong>', text)
+    text = _LINK_RE.sub(
+        lambda m: '<a href="%s">%s</a>' %
+        (re.sub(r'\.md(#|$)', r'.html\1', m.group(2)), m.group(1)),
+        text)
+    return text
+
+
+def render(path: Path) -> str:
+    lines = path.read_text(encoding='utf-8').split('\n')
+    out = ['<!doctype html><meta charset="utf-8">',
+           '<title>%s</title>' % html.escape(path.stem),
+           '<style>%s</style>' % _CSS]
+    seen: dict[str, int] = {}
+    in_code = in_list = in_table = False
+    para: list[str] = []
+
+    def flush_para():
+        if para:
+            out.append('<p>%s</p>' % _inline(' '.join(para)))
+            para.clear()
+
+    def close_blocks():
+        nonlocal in_list, in_table
+        flush_para()
+        if in_list:
+            out.append('</ul>')
+            in_list = False
+        if in_table:
+            out.append('</table>')
+            in_table = False
+
+    for line in lines:
+        if _CODE_FENCE.match(line.strip()):
+            close_blocks()
+            out.append('<pre><code>' if not in_code
+                       else '</code></pre>')
+            in_code = not in_code
+            continue
+        if in_code:
+            out.append(html.escape(line))
+            continue
+        m = _HEADING_RE.match(line)
+        if m:
+            close_blocks()
+            level = len(m.group(1))
+            slug = slugify(m.group(2), seen)
+            out.append('<h%d id="%s">%s</h%d>' %
+                       (level, slug, _inline(m.group(2)), level))
+            continue
+        if line.startswith('|'):
+            flush_para()
+            if not in_table:
+                out.append('<table>')
+                in_table = True
+            if re.fullmatch(r'[|\s:\-]+', line):
+                continue          # separator row
+            cells = [c.strip() for c in line.strip('|').split('|')]
+            out.append('<tr>%s</tr>' % ''.join(
+                '<td>%s</td>' % _inline(c) for c in cells))
+            continue
+        if re.match(r'^\s*[-*]\s+', line):
+            flush_para()
+            if in_table:
+                out.append('</table>')
+                in_table = False
+            if not in_list:
+                out.append('<ul>')
+                in_list = True
+            out.append('<li>%s</li>' %
+                       _inline(re.sub(r'^\s*[-*]\s+', '', line)))
+            continue
+        if not line.strip():
+            close_blocks()
+            continue
+        if in_list and re.match(r'^\s{2,}', line):
+            out[-1] = out[-1][:-5] + ' ' + _inline(line.strip()) + \
+                '</li>'
+            continue
+        close_blocks() if in_table else None
+        para.append(line.strip())
+    close_blocks()
+    return '\n'.join(out) + '\n'
+
+
+def build_html(outdir: str, paths: list[str]) -> int:
+    rc = check(paths)
+    if rc != 0:
+        return rc
+    import os
+    dest_root = Path(outdir)
+    targets: list[Path] = []
+    for a in paths:
+        p = Path(a)
+        targets.extend(sorted(p.rglob('*.md')) if p.is_dir() else [p])
+    resolved = [t.resolve() for t in targets]
+    # Mirror the source tree under outdir (rooted at the inputs'
+    # common parent): relative links between pages — including
+    # ../-style ones — keep working after the .md -> .html rewrite,
+    # and same-stem files in different directories can't collide.
+    base = Path(os.path.commonpath([str(t.parent) for t in resolved]))
+    count = 0
+    for t in resolved:
+        dest = (dest_root / t.relative_to(base)).with_suffix('.html')
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.write_text(render(t), encoding='utf-8')
+        count += 1
+    print('cbdocs: rendered %d page(s) into %s' % (count, dest_root))
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) >= 2 and argv[0] == 'check':
+        return check(argv[1:])
+    if len(argv) >= 3 and argv[0] == 'html':
+        return build_html(argv[1], argv[2:])
+    print('usage: cbdocs.py check <paths...> | '
+          'cbdocs.py html <outdir> <paths...>', file=sys.stderr)
+    return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
