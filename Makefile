@@ -37,15 +37,15 @@ dryrun:
 # environment ships no coverage.py/pytest-cov). Runs the suite on both
 # cores (each shadows the other's Python lines), merges the hit sets,
 # and fails under 90%.
-# Docs pipeline (reference Makefile:62-72 ghdocs analogue): gate on
-# broken links/anchors, then render the static HTML site.
-docs:
-	$(PYTHON) tools/cbdocs.py check docs README.md
-	$(PYTHON) tools/cbdocs.py html docs/_site docs README.md
-
 coverage:
 	rm -f .cbcov_hits .cbcov_pct
 	CBCOV=1 CBCOV_MERGE=.cbcov_hits $(PYTHON) -m pytest tests/ -q
 	CBCOV=1 CBCOV_MERGE=.cbcov_hits CBCOV_OUT=.cbcov_pct \
 	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -q
 	$(PYTHON) tools/cbcov.py check .cbcov_pct 90
+
+# Docs pipeline (reference Makefile:62-72 ghdocs analogue): gate on
+# broken links/anchors, then render the static HTML site.
+docs:
+	$(PYTHON) tools/cbdocs.py check docs README.md
+	$(PYTHON) tools/cbdocs.py html docs/_site docs README.md

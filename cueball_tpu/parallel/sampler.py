@@ -316,5 +316,7 @@ class FleetSampler:
             'capacity': self.fs_capacity,
             'ticks': self.fs_ticks,
             'rows': dict(self.fs_rows),
+            'actuate': self.fs_actuate,
+            'row_ticks': dict(self.fs_row_ticks),
             'latest': self.fs_latest,
         }

@@ -387,7 +387,10 @@ def test_sampler_epoch_rebase_trigger():
             import cueball_tpu.utils as mod_utils
             rel = mod_utils.current_millis() - s.fs_epoch
             assert rel < mod_sampler.EPOCH_LIMIT / 2
-            assert pool.p_uuid in s.snapshot()['rows']
+            snap = s.snapshot()
+            assert pool.p_uuid in snap['rows']
+            assert snap['actuate'] is False      # default off
+            assert set(snap['rows'].values()) <= set(snap['row_ticks'])
         finally:
             pool_monitor.detach_fleet_sampler()
             pool.stop()

@@ -1,0 +1,199 @@
+"""Integration soak: stock httpx/aiohttp clients hammering cueball
+pools through the drop-in seams while backends flap (killed with live
+sockets severed, then restarted on the same port). The claim the
+drop-ins make is that existing apps inherit cueball's failure
+handling; this drives it under concurrency, in the seeded-soak
+tradition of test_soak*.py."""
+
+import asyncio
+import random
+
+import aiohttp
+import httpx
+import pytest
+
+from cueball_tpu.integrations.aiohttp import CueballConnector
+from cueball_tpu.integrations.httpx import CueballTransport
+from cueball_tpu.resolver import StaticIpResolver
+
+from conftest import run_async
+from test_agent import MiniHttpServer
+
+SOAK_RECOVERY = {'default': {'timeout': 300, 'retries': 2,
+                             'delay': 25, 'maxDelay': 200}}
+WORKERS = 6
+REQS_PER_WORKER = 30
+
+
+class FlappingFleet:
+    """Three MiniHttpServers on fixed ports; chaos kills one (listener
+    and live sockets) and later restarts it on the same port."""
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.servers: list[MiniHttpServer | None] = []
+        self.ports: list[int] = []
+
+    async def start(self):
+        for _ in range(3):
+            srv = await MiniHttpServer().start()
+            self.servers.append(srv)
+            self.ports.append(srv.port)
+        return self
+
+    def backends(self):
+        return [{'address': '127.0.0.1', 'port': p}
+                for p in self.ports]
+
+    async def chaos(self, stop_evt):
+        while not stop_evt.is_set():
+            await asyncio.sleep(self.rng.uniform(0.05, 0.15))
+            up = [i for i, s in enumerate(self.servers)
+                  if s is not None]
+            if len(up) > 1 and self.rng.random() < 0.6:
+                i = self.rng.choice(up)
+                self.servers[i].close()
+                self.servers[i] = None
+            else:
+                down = [i for i, s in enumerate(self.servers)
+                        if s is None]
+                if down:
+                    i = self.rng.choice(down)
+                    try:
+                        self.servers[i] = await MiniHttpServer(
+                            self.ports[i]).start()
+                    except OSError:
+                        pass     # port still in TIME_WAIT; next pass
+        # Restore everything for the final verification round.
+        for i, s in enumerate(self.servers):
+            if s is None:
+                for _ in range(40):
+                    try:
+                        self.servers[i] = await MiniHttpServer(
+                            self.ports[i]).start()
+                        break
+                    except OSError:
+                        await asyncio.sleep(0.05)
+
+    def close(self):
+        for s in self.servers:
+            if s is not None:
+                s.close()
+
+
+@pytest.mark.parametrize('seed', [1, 7])
+def test_httpx_transport_soak_backend_flaps(seed):
+    async def t():
+        rng = random.Random(seed)
+        fleet = await FlappingFleet(rng).start()
+        transport = CueballTransport({'spares': 2, 'maximum': 6,
+                                      'recovery': SOAK_RECOVERY})
+        transport.agent_for('http').create_pool(
+            'svc.soak', {'resolver': StaticIpResolver(
+                {'backends': fleet.backends()})})
+        ok = err = 0
+        async with httpx.AsyncClient(
+                transport=transport,
+                timeout=httpx.Timeout(3.0)) as client:
+
+            async def worker():
+                nonlocal ok, err
+                for _ in range(REQS_PER_WORKER):
+                    try:
+                        r = await client.get('http://svc.soak/')
+                        assert r.status_code == 200
+                        assert r.text.startswith('hello from')
+                        ok += 1
+                    except httpx.TransportError:
+                        # The ONLY acceptable failure mode: the host
+                        # library's own transport errors.
+                        err += 1
+                    await asyncio.sleep(rng.uniform(0, 0.01))
+
+            stop_evt = asyncio.Event()
+            chaos = asyncio.ensure_future(fleet.chaos(stop_evt))
+            await asyncio.gather(*[worker() for _ in range(WORKERS)])
+            stop_evt.set()
+            await chaos
+
+            total = WORKERS * REQS_PER_WORKER
+            assert ok + err == total
+            assert ok > total * 0.5, \
+                'only %d/%d succeeded under flaps' % (ok, total)
+            pool = transport.agent_for('http').pools['svc.soak']
+            assert pool.get_stats()['totalConnections'] <= 6
+
+            # Chaos over, all backends restored: service recovers.
+            final = 0
+            for _ in range(80):
+                try:
+                    r = await client.get('http://svc.soak/')
+                    if r.status_code == 200:
+                        final += 1
+                        if final >= 10:
+                            break
+                except httpx.TransportError:
+                    pass
+                await asyncio.sleep(0.05)
+            assert final >= 10, 'no recovery after chaos'
+        fleet.close()
+    run_async(t())
+
+
+@pytest.mark.parametrize('seed', [3])
+def test_aiohttp_connector_soak_backend_flaps(seed):
+    async def t():
+        rng = random.Random(seed)
+        fleet = await FlappingFleet(rng).start()
+        connector = CueballConnector({'spares': 2, 'maximum': 6,
+                                      'recovery': SOAK_RECOVERY})
+        connector.create_pool('svc.soak', 80,
+                              resolver=StaticIpResolver(
+                                  {'backends': fleet.backends()}))
+        ok = err = 0
+        async with aiohttp.ClientSession(
+                connector=connector,
+                timeout=aiohttp.ClientTimeout(total=3)) as session:
+
+            async def worker():
+                nonlocal ok, err
+                for _ in range(REQS_PER_WORKER):
+                    try:
+                        async with session.get(
+                                'http://svc.soak/') as r:
+                            assert r.status == 200
+                            text = await r.text()
+                            assert text.startswith('hello from')
+                            ok += 1
+                    except (aiohttp.ClientError,
+                            asyncio.TimeoutError):
+                        err += 1
+                    await asyncio.sleep(rng.uniform(0, 0.01))
+
+            stop_evt = asyncio.Event()
+            chaos = asyncio.ensure_future(fleet.chaos(stop_evt))
+            await asyncio.gather(*[worker() for _ in range(WORKERS)])
+            stop_evt.set()
+            await chaos
+
+            total = WORKERS * REQS_PER_WORKER
+            assert ok + err == total
+            assert ok > total * 0.5, \
+                'only %d/%d succeeded under flaps' % (ok, total)
+            pool = connector.get_pool('svc.soak', 80)
+            assert pool.get_stats()['totalConnections'] <= 6
+
+            final = 0
+            for _ in range(80):
+                try:
+                    async with session.get('http://svc.soak/') as r:
+                        if r.status == 200:
+                            final += 1
+                            if final >= 10:
+                                break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.05)
+            assert final >= 10, 'no recovery after chaos'
+        fleet.close()
+    run_async(t())

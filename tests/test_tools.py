@@ -359,3 +359,47 @@ def test_docs_html_mirrors_tree_for_relative_links(tmp_path):
     idx = (out / 'docs' / 'index.html').read_text()
     assert '<a href="../README.html">' in idx
     assert (out / 'README.html').exists()
+
+
+def test_docs_underscores_preserved_in_slugs(tmp_path):
+    # GitHub preserves literal underscores in anchors.
+    (tmp_path / 'a.md').write_text(
+        '# resolver_for_ip_or_domain\n\n'
+        '[x](#resolver_for_ip_or_domain)\n')
+    assert cbdocs.check([str(tmp_path)]) == 0
+
+
+def test_docs_code_spans_masked(tmp_path):
+    # Literal link syntax inside inline code is an example, not a
+    # link: the gate must not chase it and the renderer must keep it
+    # literal.
+    (tmp_path / 'a.md').write_text(
+        '# T\n\nUse `[text](missing.md)` to make a link.\n')
+    assert cbdocs.check([str(tmp_path)]) == 0
+    out = tmp_path / 'site'
+    assert cbdocs.build_html(str(out), [str(tmp_path)]) == 0
+    a = (out / 'a.html').read_text()
+    assert '<code>[text](missing.md)</code>' in a
+    assert '<a href' not in a
+
+
+def test_docs_external_urls_not_rewritten(tmp_path):
+    (tmp_path / 'a.md').write_text(
+        '# T\n\n[gh](https://github.com/x/y/blob/main/doc.md)\n')
+    out = tmp_path / 'site'
+    assert cbdocs.build_html(str(out), [str(tmp_path)]) == 0
+    a = (out / 'a.html').read_text()
+    assert 'blob/main/doc.md"' in a, 'external .md must stay .md'
+
+
+def test_docs_lazily_scanned_targets_not_rendered(tmp_path):
+    # README.md is linked from docs/ but not passed as an input: it
+    # is checked (anchors) yet must not appear in the rendered site.
+    sub = tmp_path / 'docs'
+    sub.mkdir()
+    (tmp_path / 'README.md').write_text('# Top\n\nHi.\n')
+    (sub / 'a.md').write_text('[up](../README.md#top)\n')
+    out = tmp_path / 'site'
+    assert cbdocs.build_html(str(out), [str(sub)]) == 0
+    assert (out / 'a.html').exists()
+    assert not (out / 'README.html').exists()

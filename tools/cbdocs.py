@@ -31,9 +31,11 @@ _CODE_FENCE = re.compile(r'^(```|~~~)')
 
 
 def slugify(heading: str, seen: dict[str, int]) -> str:
-    """GitHub anchor slug: lowercase, drop non-word chars except
-    spaces/dashes, spaces to dashes, -N for duplicates."""
-    s = re.sub(r'[`*_]', '', heading.strip()).lower()
+    """GitHub anchor slug: strip markdown formatting (backticks,
+    asterisks — literal underscores are PRESERVED, as GitHub does),
+    lowercase, drop non-word chars except spaces/dashes, spaces to
+    dashes, -N for duplicates."""
+    s = re.sub(r'[`*]', '', heading.strip()).lower()
     s = re.sub(r'[^\w\- ]', '', s)
     s = s.replace(' ', '-')
     n = seen.get(s)
@@ -59,7 +61,10 @@ def scan_doc(path: Path) -> tuple[list[str], list[tuple[int, str]]]:
         m = _HEADING_RE.match(line)
         if m:
             anchors.append(slugify(m.group(2), seen))
-        for lm in _LINK_RE.finditer(line):
+        # Inline code spans may show literal link syntax as an
+        # example; mask them before link extraction.
+        no_code = re.sub(r'`[^`]*`', '', line)
+        for lm in _LINK_RE.finditer(no_code):
             target = lm.group(2)
             if target.startswith(('http://', 'https://', 'mailto:')):
                 continue
@@ -77,8 +82,10 @@ def collect(paths: list[str]) -> dict[Path, tuple[list, list]]:
     return docs
 
 
-def check(paths: list[str]) -> int:
-    docs = collect(paths)
+def check(paths: list[str],
+          docs: dict[Path, tuple[list, list]] | None = None) -> int:
+    if docs is None:
+        docs = collect(paths)
     errors = []
     # Snapshot: anchored links into files outside the scanned set are
     # lazily scanned into `docs` below, which must not break the walk.
@@ -126,15 +133,31 @@ padding:.3em .6em}h1,h2{border-bottom:1px solid #d8dee4;
 padding-bottom:.3rem}a{color:#0b57d0}'''
 
 
+def _link_href(target: str) -> str:
+    """Rewrite .md -> .html for local pages only; external URLs pass
+    through untouched (only local pages get rendered)."""
+    if target.startswith(('http://', 'https://', 'mailto:')):
+        return target
+    return re.sub(r'\.md(#|$)', r'.html\1', target)
+
+
 def _inline(text: str) -> str:
     text = html.escape(text, quote=False)
-    text = re.sub(r'`([^`]+)`', r'<code>\1</code>', text)
+    # Stash code spans first so link/bold markup inside them stays
+    # literal (docs show link syntax as examples).
+    stash: list[str] = []
+
+    def _stash(m):
+        stash.append('<code>%s</code>' % m.group(1))
+        return '\x00%d\x00' % (len(stash) - 1)
+
+    text = re.sub(r'`([^`]+)`', _stash, text)
     text = re.sub(r'\*\*([^*]+)\*\*', r'<strong>\1</strong>', text)
     text = _LINK_RE.sub(
         lambda m: '<a href="%s">%s</a>' %
-        (re.sub(r'\.md(#|$)', r'.html\1', m.group(2)), m.group(1)),
-        text)
-    return text
+        (_link_href(m.group(2)), m.group(1)), text)
+    return re.sub(r'\x00(\d+)\x00',
+                  lambda m: stash[int(m.group(1))], text)
 
 
 def render(path: Path) -> str:
@@ -215,16 +238,15 @@ def render(path: Path) -> str:
 
 
 def build_html(outdir: str, paths: list[str]) -> int:
-    rc = check(paths)
+    docs = collect(paths)
+    # Snapshot before check(): it lazily scans link targets outside
+    # the input set, which are checked but never rendered.
+    resolved = list(docs)
+    rc = check(paths, docs)
     if rc != 0:
         return rc
     import os
     dest_root = Path(outdir)
-    targets: list[Path] = []
-    for a in paths:
-        p = Path(a)
-        targets.extend(sorted(p.rglob('*.md')) if p.is_dir() else [p])
-    resolved = [t.resolve() for t in targets]
     # Mirror the source tree under outdir (rooted at the inputs'
     # common parent): relative links between pages — including
     # ../-style ones — keep working after the .md -> .html rewrite,
