@@ -248,17 +248,20 @@ def rebase_state(state: FleetState, shift) -> FleetState:
         now_ms=jnp.maximum(state.now_ms - shift, 0.0))
 
 
-# The ONE enumeration of how fleet data shards over the 'pools' mesh
-# axis; every sharded entry point below derives from these three, so a
-# new FleetInputs/output field is placed in exactly one spot.
+# The ONE enumeration of how fleet data shards over the mesh; every
+# sharded entry point below derives from these three, so a new
+# FleetInputs/output field is placed in exactly one spot. `axes` names
+# the mesh axes the pools dimension shards over: ('pools',) on a flat
+# ICI mesh, ('host', 'chip') on a multi-host topology where the outer
+# axis crosses DCN and the inner one rides ICI.
 
-def _step_shardings(mesh: Mesh):
+def _step_shardings(mesh: Mesh, axes: tuple = ('pools',)):
     """(state, inputs, (state, per-pool outs, aggregates)) shardings
     for one fleet_step tick."""
-    pool = NamedSharding(mesh, P('pools'))
+    pool = NamedSharding(mesh, P(axes))
     scalar = NamedSharding(mesh, P())
     state = FleetState(
-        windows=NamedSharding(mesh, P('pools', None)),
+        windows=NamedSharding(mesh, P(axes, None)),
         codel=CodelState(pool, pool, pool, pool),
         now_ms=scalar)
     inputs = FleetInputs(
@@ -279,13 +282,14 @@ def _prepend_time_axis(sharding: NamedSharding, mesh: Mesh):
     return NamedSharding(mesh, P(*((None,) + tuple(sharding.spec))))
 
 
-def make_sharded_step(mesh: Mesh):
+def make_sharded_step(mesh: Mesh, axes: tuple = ('pools',)):
     """Build a jitted step with every [pools, ...] array sharded over
-    the mesh's 'pools' axis. The per-pool math is embarrassingly
-    parallel (no resharding); the fleet aggregates compile to psum-style
-    all-reduces over ICI."""
+    the given mesh axes. The per-pool math is embarrassingly parallel
+    (no resharding); the fleet aggregates compile to psum-style
+    all-reduces — over ICI on a flat mesh, hierarchically (ICI within
+    a host, DCN across hosts) on a 2-D ('host', 'chip') mesh."""
     state_shardings, input_shardings, out_shardings = \
-        _step_shardings(mesh)
+        _step_shardings(mesh, axes)
     return jax.jit(fleet_step,
                    in_shardings=(state_shardings, input_shardings),
                    out_shardings=out_shardings)
@@ -319,10 +323,16 @@ def shard_window(window: FleetInputs, mesh: Mesh) -> FleetInputs:
     return jax.tree.map(jax.device_put, window, window_shardings)
 
 
-def make_shardmap_step(mesh: Mesh):
-    """The SPMD form of :func:`fleet_step`: shard_map over the 'pools'
-    mesh axis with hand-written collectives — per-pool laws run on the
-    local shard, fleet aggregates are jax.lax.psum / pmax over ICI.
+def make_shardmap_step(mesh: Mesh, axes: tuple = ('pools',)):
+    """The SPMD form of :func:`fleet_step`: shard_map over the given
+    mesh axes with hand-written collectives — per-pool laws run on the
+    local shard, fleet aggregates are jax.lax.psum / pmax.
+
+    On a flat ('pools',) mesh the reduction is one all-reduce over
+    ICI. On a 2-D ('host', 'chip') mesh the reduction is staged
+    innermost-first — reduce over 'chip' (ICI, within a host), then
+    over 'host' (DCN) — the canonical hierarchical all-reduce for
+    multi-host topologies.
 
     Semantically identical to fleet_step; the multichip dryrun asserts
     so (a wrong collective here genuinely fails the allclose, unlike
@@ -332,9 +342,15 @@ def make_shardmap_step(mesh: Mesh):
     except ImportError:  # pragma: no cover
         from jax.experimental.shard_map import shard_map
 
-    pool = P('pools')
-    window = P('pools', None)
+    pool = P(axes)
+    window = P(axes, None)
     scalar = P()
+
+    def _reduce(v, op):
+        # Innermost mesh axis first (ICI), outermost last (DCN).
+        for ax in reversed(axes):
+            v = op(v, ax)
+        return v
 
     state_specs = FleetState(
         windows=window,
@@ -356,8 +372,8 @@ def make_shardmap_step(mesh: Mesh):
     def local(state, inp):
         new_state, out = _local_step(state, inp)
         p = _partial_sums(inp, out)
-        p = {k: (jax.lax.pmax(v, 'pools') if k == 'max_sojourn'
-                 else jax.lax.psum(v, 'pools'))
+        p = {k: (_reduce(v, jax.lax.pmax) if k == 'max_sojourn'
+                 else _reduce(v, jax.lax.psum))
              for k, v in p.items()}
         return new_state, out, _finalize(p)
 
@@ -366,11 +382,13 @@ def make_shardmap_step(mesh: Mesh):
         out_specs=out_specs))
 
 
-def shard_state(state: FleetState, mesh: Mesh) -> FleetState:
-    state_shardings, _, _ = _step_shardings(mesh)
+def shard_state(state: FleetState, mesh: Mesh,
+                axes: tuple = ('pools',)) -> FleetState:
+    state_shardings, _, _ = _step_shardings(mesh, axes)
     return jax.tree.map(jax.device_put, state, state_shardings)
 
 
-def shard_inputs(inp: FleetInputs, mesh: Mesh) -> FleetInputs:
-    _, input_shardings, _ = _step_shardings(mesh)
+def shard_inputs(inp: FleetInputs, mesh: Mesh,
+                 axes: tuple = ('pools',)) -> FleetInputs:
+    _, input_shardings, _ = _step_shardings(mesh, axes)
     return jax.tree.map(jax.device_put, inp, input_shardings)

@@ -314,3 +314,51 @@ def test_fleet_scan_matches_sequential_steps():
         expect = np.stack([np.asarray(f[k]) for f in seq_fleets])
         np.testing.assert_allclose(np.asarray(scan_fleets[k]), expect,
                                    rtol=1e-4, err_msg=k)
+
+
+def test_2d_host_chip_mesh_hierarchical_collectives():
+    """Multi-host topology: pools sharded over a 2-D ('host', 'chip')
+    mesh. GSPMD gets multi-axis NamedShardings; the shard_map form
+    reduces hierarchically (psum/pmax over 'chip' then 'host' — ICI
+    within a host, DCN across). Both must match the unsharded laws."""
+    from jax.sharding import Mesh
+    from cueball_tpu.parallel import fleet_init, fleet_inputs
+    from cueball_tpu.parallel.telemetry import (
+        fleet_step, make_sharded_step, make_shardmap_step,
+        shard_inputs, shard_state)
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ('host', 'chip'))
+    axes = ('host', 'chip')
+    n = 32
+    rng = np.random.default_rng(33)
+    inp = fleet_inputs(
+        n,
+        samples=jnp.asarray(rng.uniform(0, 6, size=n), jnp.float32),
+        sojourns=jnp.asarray(rng.uniform(0, 400, size=n), jnp.float32),
+        target_delay=jnp.full((n,), 250.0, jnp.float32),
+        spares=jnp.full((n,), 2.0, jnp.float32),
+        n_retrying=jnp.asarray(rng.integers(0, 2, size=n), jnp.float32),
+        retry_delay=jnp.full((n,), 100.0, jnp.float32),
+        retry_max_delay=jnp.full((n,), 8000.0, jnp.float32),
+        retry_attempt=jnp.asarray(rng.integers(0, 5, size=n),
+                                  jnp.float32),
+        active=jnp.ones((n,), bool),
+        now_ms=jnp.float32(500.0))
+    state0 = fleet_init(n)
+    s_un, o_un, f_un = fleet_step(state0, inp)
+
+    for make in (make_sharded_step, make_shardmap_step):
+        step = make(mesh, axes)
+        s_sh, o_sh, f_sh = step(shard_state(state0, mesh, axes),
+                                shard_inputs(inp, mesh, axes))
+        np.testing.assert_allclose(np.asarray(s_sh.windows),
+                                   np.asarray(s_un.windows), rtol=1e-5)
+        for k in o_un:
+            np.testing.assert_allclose(
+                np.asarray(o_sh[k]), np.asarray(o_un[k]), rtol=1e-4,
+                err_msg='%s %s' % (make.__name__, k))
+        for k in f_un:
+            np.testing.assert_allclose(
+                float(f_sh[k]), float(f_un[k]), rtol=1e-4,
+                err_msg='%s %s' % (make.__name__, k))

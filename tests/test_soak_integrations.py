@@ -92,51 +92,59 @@ def test_httpx_transport_soak_backend_flaps(seed):
             'svc.soak', {'resolver': StaticIpResolver(
                 {'backends': fleet.backends()})})
         ok = err = 0
-        async with httpx.AsyncClient(
-                transport=transport,
-                timeout=httpx.Timeout(3.0)) as client:
+        try:
+            async with httpx.AsyncClient(
+                    transport=transport,
+                    timeout=httpx.Timeout(3.0)) as client:
 
-            async def worker():
-                nonlocal ok, err
-                for _ in range(REQS_PER_WORKER):
+                async def worker():
+                    nonlocal ok, err
+                    for _ in range(REQS_PER_WORKER):
+                        try:
+                            r = await client.get('http://svc.soak/')
+                            assert r.status_code == 200
+                            assert r.text.startswith('hello from')
+                            ok += 1
+                        except httpx.TransportError:
+                            # The ONLY acceptable failure mode: the
+                            # host library's own transport errors.
+                            err += 1
+                        await asyncio.sleep(rng.uniform(0, 0.01))
+
+                stop_evt = asyncio.Event()
+                chaos = asyncio.ensure_future(fleet.chaos(stop_evt))
+                try:
+                    await asyncio.gather(
+                        *[worker() for _ in range(WORKERS)])
+                finally:
+                    # A failed mid-soak assertion must still stop
+                    # chaos, or the leaked task/servers mask the real
+                    # failure with secondary noise.
+                    stop_evt.set()
+                    await chaos
+
+                total = WORKERS * REQS_PER_WORKER
+                assert ok + err == total
+                assert ok > total * 0.5, \
+                    'only %d/%d succeeded under flaps' % (ok, total)
+                pool = transport.agent_for('http').pools['svc.soak']
+                assert pool.get_stats()['totalConnections'] <= 6
+
+                # Chaos over, all backends restored: service recovers.
+                final = 0
+                for _ in range(80):
                     try:
                         r = await client.get('http://svc.soak/')
-                        assert r.status_code == 200
-                        assert r.text.startswith('hello from')
-                        ok += 1
+                        if r.status_code == 200:
+                            final += 1
+                            if final >= 10:
+                                break
                     except httpx.TransportError:
-                        # The ONLY acceptable failure mode: the host
-                        # library's own transport errors.
-                        err += 1
-                    await asyncio.sleep(rng.uniform(0, 0.01))
-
-            stop_evt = asyncio.Event()
-            chaos = asyncio.ensure_future(fleet.chaos(stop_evt))
-            await asyncio.gather(*[worker() for _ in range(WORKERS)])
-            stop_evt.set()
-            await chaos
-
-            total = WORKERS * REQS_PER_WORKER
-            assert ok + err == total
-            assert ok > total * 0.5, \
-                'only %d/%d succeeded under flaps' % (ok, total)
-            pool = transport.agent_for('http').pools['svc.soak']
-            assert pool.get_stats()['totalConnections'] <= 6
-
-            # Chaos over, all backends restored: service recovers.
-            final = 0
-            for _ in range(80):
-                try:
-                    r = await client.get('http://svc.soak/')
-                    if r.status_code == 200:
-                        final += 1
-                        if final >= 10:
-                            break
-                except httpx.TransportError:
-                    pass
-                await asyncio.sleep(0.05)
-            assert final >= 10, 'no recovery after chaos'
-        fleet.close()
+                        pass
+                    await asyncio.sleep(0.05)
+                assert final >= 10, 'no recovery after chaos'
+        finally:
+            fleet.close()
     run_async(t())
 
 
@@ -151,49 +159,55 @@ def test_aiohttp_connector_soak_backend_flaps(seed):
                               resolver=StaticIpResolver(
                                   {'backends': fleet.backends()}))
         ok = err = 0
-        async with aiohttp.ClientSession(
-                connector=connector,
-                timeout=aiohttp.ClientTimeout(total=3)) as session:
+        try:
+            async with aiohttp.ClientSession(
+                    connector=connector,
+                    timeout=aiohttp.ClientTimeout(total=3)) as session:
 
-            async def worker():
-                nonlocal ok, err
-                for _ in range(REQS_PER_WORKER):
+                async def worker():
+                    nonlocal ok, err
+                    for _ in range(REQS_PER_WORKER):
+                        try:
+                            async with session.get(
+                                    'http://svc.soak/') as r:
+                                assert r.status == 200
+                                text = await r.text()
+                                assert text.startswith('hello from')
+                                ok += 1
+                        except (aiohttp.ClientError,
+                                asyncio.TimeoutError):
+                            err += 1
+                        await asyncio.sleep(rng.uniform(0, 0.01))
+
+                stop_evt = asyncio.Event()
+                chaos = asyncio.ensure_future(fleet.chaos(stop_evt))
+                try:
+                    await asyncio.gather(
+                        *[worker() for _ in range(WORKERS)])
+                finally:
+                    stop_evt.set()
+                    await chaos
+
+                total = WORKERS * REQS_PER_WORKER
+                assert ok + err == total
+                assert ok > total * 0.5, \
+                    'only %d/%d succeeded under flaps' % (ok, total)
+                pool = connector.get_pool('svc.soak', 80)
+                assert pool.get_stats()['totalConnections'] <= 6
+
+                final = 0
+                for _ in range(80):
                     try:
                         async with session.get(
                                 'http://svc.soak/') as r:
-                            assert r.status == 200
-                            text = await r.text()
-                            assert text.startswith('hello from')
-                            ok += 1
-                    except (aiohttp.ClientError,
-                            asyncio.TimeoutError):
-                        err += 1
-                    await asyncio.sleep(rng.uniform(0, 0.01))
-
-            stop_evt = asyncio.Event()
-            chaos = asyncio.ensure_future(fleet.chaos(stop_evt))
-            await asyncio.gather(*[worker() for _ in range(WORKERS)])
-            stop_evt.set()
-            await chaos
-
-            total = WORKERS * REQS_PER_WORKER
-            assert ok + err == total
-            assert ok > total * 0.5, \
-                'only %d/%d succeeded under flaps' % (ok, total)
-            pool = connector.get_pool('svc.soak', 80)
-            assert pool.get_stats()['totalConnections'] <= 6
-
-            final = 0
-            for _ in range(80):
-                try:
-                    async with session.get('http://svc.soak/') as r:
-                        if r.status == 200:
-                            final += 1
-                            if final >= 10:
-                                break
-                except aiohttp.ClientError:
-                    pass
-                await asyncio.sleep(0.05)
-            assert final >= 10, 'no recovery after chaos'
-        fleet.close()
+                            if r.status == 200:
+                                final += 1
+                                if final >= 10:
+                                    break
+                    except aiohttp.ClientError:
+                        pass
+                    await asyncio.sleep(0.05)
+                assert final >= 10, 'no recovery after chaos'
+        finally:
+            fleet.close()
     run_async(t())

@@ -383,6 +383,14 @@ def test_docs_code_spans_masked(tmp_path):
     assert '<a href' not in a
 
 
+def test_docs_code_span_link_text_still_gated(tmp_path):
+    # A link whose text is entirely a code span is still a link; its
+    # target must be checked (masking must not delete the span).
+    (tmp_path / 'a.md').write_text(
+        '# T\n\n[`cb.Pool`](missing.md)\n')
+    assert cbdocs.check([str(tmp_path)]) == 1
+
+
 def test_docs_external_urls_not_rewritten(tmp_path):
     (tmp_path / 'a.md').write_text(
         '# T\n\n[gh](https://github.com/x/y/blob/main/doc.md)\n')
