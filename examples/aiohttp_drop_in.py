@@ -1,0 +1,119 @@
+"""One-line adoption: a stock aiohttp app on cueball pools.
+
+The aiohttp twin of examples/httpx_drop_in.py (the reference's
+README.adoc:35-141 adoption story): an ordinary
+``aiohttp.ClientSession`` whose ONLY cueball-specific line is the
+``connector=`` argument. Here the app fans out CONCURRENT requests —
+aiohttp's natural shape — so the pool's claim queue, spares
+maintenance and failover all engage at once.
+
+Self-contained: starts two tiny HTTP backends behind a static
+resolver, fans 30 concurrent requests over the shared pool, kills one
+backend mid-run, and shows traffic continuing on the survivor.
+
+    python examples/aiohttp_drop_in.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import aiohttp
+
+from cueball_tpu.integrations.aiohttp import CueballConnector
+from cueball_tpu.resolver import StaticIpResolver
+
+
+class Backend:
+    def __init__(self, name):
+        self.name = name
+        self._writers = set()
+
+    async def start(self):
+        self.srv = await asyncio.start_server(
+            self._handle, '127.0.0.1', 0)
+        self.port = self.srv.sockets[0].getsockname()[1]
+        return self
+
+    async def _handle(self, reader, writer):
+        self._writers.add(writer)
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                if line in (b'\r\n', b'\n'):
+                    await asyncio.sleep(0.01)   # pretend to work
+                    body = self.name.encode()
+                    writer.write(
+                        b'HTTP/1.1 200 OK\r\nContent-Length: %d\r\n'
+                        b'\r\n%s' % (len(body), body))
+                    await writer.drain()
+        except ConnectionError:
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    def kill(self):
+        self.srv.close()
+        for w in list(self._writers):
+            w.close()
+
+
+async def main():
+    srv_a = await Backend('backend-a').start()
+    srv_b = await Backend('backend-b').start()
+
+    connector = CueballConnector({
+        'spares': 2, 'maximum': 6,
+        'recovery': {'default': {'timeout': 500, 'retries': 2,
+                                 'delay': 50, 'maxDelay': 500}},
+    })
+    connector.create_pool('api.internal', 80,
+                          resolver=StaticIpResolver({'backends': [
+                              {'address': '127.0.0.1',
+                               'port': srv_a.port},
+                              {'address': '127.0.0.1',
+                               'port': srv_b.port},
+                          ]}))
+
+    # From here down this is a stock aiohttp app.
+    async with aiohttp.ClientSession(connector=connector) as session:
+        async def fetch():
+            async with session.get('http://api.internal/') as r:
+                return await r.text()
+
+        served = {}
+        for name in await asyncio.gather(*[fetch()
+                                           for _ in range(30)]):
+            served[name] = served.get(name, 0) + 1
+        print('30 concurrent requests pooled over %d backends: %s' %
+              (len(served), dict(sorted(served.items()))))
+        pool = connector.get_pool('api.internal', 80)
+        print('pool held %d connections (maximum 6)' %
+              pool.get_stats()['totalConnections'])
+
+        srv_a.kill()            # crash backend-a, live sockets and all
+
+        survivors = 0
+        deadline = asyncio.get_running_loop().time() + 8
+        while survivors < 10 and \
+                asyncio.get_running_loop().time() < deadline:
+            try:
+                if await fetch() == 'backend-b':
+                    survivors += 1
+            except aiohttp.ClientError:
+                await asyncio.sleep(0.05)
+        print('%d/10 requests served by the survivor after failover'
+              % survivors)
+
+    srv_b.kill()
+    print('clean shutdown')
+
+
+if __name__ == '__main__':
+    asyncio.run(main())

@@ -20,6 +20,17 @@ def test_httpx_drop_in_example():
     assert 'clean shutdown' in r.stdout
 
 
+def test_aiohttp_drop_in_example():
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, 'examples', 'aiohttp_drop_in.py')],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert '30 concurrent requests pooled over 2 backends' in r.stdout
+    assert '10/10 requests served by the survivor' in r.stdout
+    assert 'clean shutdown' in r.stdout
+
+
 def test_multiplexed_set_client_example():
     r = subprocess.run(
         [sys.executable,

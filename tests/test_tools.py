@@ -411,3 +411,11 @@ def test_docs_lazily_scanned_targets_not_rendered(tmp_path):
     assert cbdocs.build_html(str(out), [str(sub)]) == 0
     assert (out / 'a.html').exists()
     assert not (out / 'README.html').exists()
+
+
+def test_docs_code_span_as_link_target_not_a_link(tmp_path):
+    # A code span used AS the target is example syntax, not a link;
+    # the gate must not chase a phantom path.
+    (tmp_path / 'a.md').write_text(
+        '# T\n\nWrite [text](`relative/path.md`) to link.\n')
+    assert cbdocs.check([str(tmp_path)]) == 0

@@ -62,12 +62,13 @@ def scan_doc(path: Path) -> tuple[list[str], list[tuple[int, str]]]:
         if m:
             anchors.append(slugify(m.group(2), seen))
         # Inline code spans may show literal link syntax as an
-        # example; mask them before link extraction. Mask WITH a
-        # placeholder, not '': deleting the span would turn
-        # [`code`](target.md) into [](target.md), whose empty text
-        # fails _LINK_RE and exempts the target from the gate while
-        # the renderer still links it.
-        no_code = re.sub(r'`[^`]*`', 'x', line)
+        # example; mask them before link extraction. The mask is a
+        # single SPACE, chosen so both edge shapes resolve correctly:
+        # [`code`](target.md) becomes [ ](target.md) — text survives,
+        # target stays gated — while [text](`x`) becomes [text]( ),
+        # whose space-containing target fails _LINK_RE, so a span
+        # used AS the target is not misread as a path named 'x'.
+        no_code = re.sub(r'`[^`]*`', ' ', line)
         for lm in _LINK_RE.finditer(no_code):
             target = lm.group(2)
             if target.startswith(('http://', 'https://', 'mailto:')):
