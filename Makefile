@@ -22,6 +22,7 @@ check:
 # The full CI gate, runnable locally: build from source, lint, test on
 # both cores, dryrun the multichip sharding path.
 ci: native check
+	$(PYTHON) tools/cbdocs.py check docs README.md
 	$(PYTHON) -m pytest tests/ -x -q
 	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -x -q
 	$(MAKE) dryrun

@@ -327,10 +327,9 @@ def test_2d_host_chip_mesh_hierarchical_collectives():
         fleet_step, make_sharded_step, make_shardmap_step,
         shard_inputs, shard_state)
 
-    if len(jax.devices()) < 8:
-        pytest.skip('needs the 8-device virtual CPU mesh')
-    devs = np.array(jax.devices()[:8]).reshape(2, 4)
-    mesh = Mesh(devs, ('host', 'chip'))
+    devs = np.array(jax.devices()[:8])
+    assert len(devs) == 8, 'conftest should force 8 cpu devices'
+    mesh = Mesh(devs.reshape(2, 4), ('host', 'chip'))
     axes = ('host', 'chip')
     n = 32
     rng = np.random.default_rng(33)

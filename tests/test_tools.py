@@ -419,3 +419,15 @@ def test_docs_code_span_as_link_target_not_a_link(tmp_path):
     (tmp_path / 'a.md').write_text(
         '# T\n\nWrite [text](`relative/path.md`) to link.\n')
     assert cbdocs.check([str(tmp_path)]) == 0
+
+
+def test_docs_code_span_as_link_target_renders_literal(tmp_path):
+    # ...and the renderer agrees with the gate: no anchor with a
+    # garbage href, the span stays literal code.
+    (tmp_path / 'a.md').write_text(
+        '# T\n\nWrite [text](`relative/path.md`) to link.\n')
+    out = tmp_path / 'site'
+    assert cbdocs.build_html(str(out), [str(tmp_path)]) == 0
+    a = (out / 'a.html').read_text()
+    assert '<a href' not in a
+    assert '<code>relative/path.md</code>' in a

@@ -149,19 +149,23 @@ def _link_href(target: str) -> str:
 def _inline(text: str) -> str:
     text = html.escape(text, quote=False)
     # Stash code spans first so link/bold markup inside them stays
-    # literal (docs show link syntax as examples).
+    # literal (docs show link syntax as examples). The placeholder
+    # CONTAINS SPACES so that a stashed span in link-target position
+    # fails _LINK_RE's no-whitespace target group — mirroring the
+    # gate, which also declines to treat [text](`span`) as a link —
+    # instead of rendering an anchor with a garbage href.
     stash: list[str] = []
 
     def _stash(m):
         stash.append('<code>%s</code>' % m.group(1))
-        return '\x00%d\x00' % (len(stash) - 1)
+        return '\x00 %d \x00' % (len(stash) - 1)
 
     text = re.sub(r'`([^`]+)`', _stash, text)
     text = re.sub(r'\*\*([^*]+)\*\*', r'<strong>\1</strong>', text)
     text = _LINK_RE.sub(
         lambda m: '<a href="%s">%s</a>' %
         (_link_href(m.group(2)), m.group(1)), text)
-    return re.sub(r'\x00(\d+)\x00',
+    return re.sub(r'\x00 (\d+) \x00',
                   lambda m: stash[int(m.group(1))], text)
 
 
