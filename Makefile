@@ -4,7 +4,7 @@ PYTHON ?= python3
 LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
-.PHONY: test check bench dryrun coverage native ci docs
+.PHONY: test check bench dryrun coverage native ci docs docs-check
 
 native:
 	$(PYTHON) native/build.py
@@ -21,8 +21,7 @@ check:
 
 # The full CI gate, runnable locally: build from source, lint, test on
 # both cores, dryrun the multichip sharding path.
-ci: native check
-	$(PYTHON) tools/cbdocs.py check docs README.md
+ci: native check docs-check
 	$(PYTHON) -m pytest tests/ -x -q
 	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -x -q
 	$(MAKE) dryrun
@@ -46,7 +45,12 @@ coverage:
 	$(PYTHON) tools/cbcov.py check .cbcov_pct 90
 
 # Docs pipeline (reference Makefile:62-72 ghdocs analogue): gate on
-# broken links/anchors, then render the static HTML site.
-docs:
-	$(PYTHON) tools/cbdocs.py check docs README.md
-	$(PYTHON) tools/cbdocs.py html docs/_site docs README.md
+# broken links/anchors (docs-check, the ONE place the doc set is
+# listed), then render the static HTML site.
+DOC_ROOTS = docs README.md
+
+docs-check:
+	$(PYTHON) tools/cbdocs.py check $(DOC_ROOTS)
+
+docs: docs-check
+	$(PYTHON) tools/cbdocs.py html docs/_site $(DOC_ROOTS)

@@ -295,21 +295,23 @@ def make_sharded_step(mesh: Mesh, axes: tuple = ('pools',)):
                    out_shardings=out_shardings)
 
 
-def make_sharded_scan(mesh: Mesh):
+def make_sharded_scan(mesh: Mesh, axes: tuple = ('pools',)):
     """fleet_scan with the pools axis sharded over the mesh INSIDE the
     scan: each device carries its pool shard through all T ticks, so a
     whole recorded window replays data-parallel with the per-tick fleet
-    aggregates still reducing over ICI. The dryrun asserts it matches
-    the unsharded scan."""
-    state_shardings, window_shardings, scan_out = _scan_shardings(mesh)
+    aggregates still reducing over ICI (hierarchically on a 2-D
+    ('host', 'chip') mesh). The dryrun asserts it matches the
+    unsharded scan."""
+    state_shardings, window_shardings, scan_out = \
+        _scan_shardings(mesh, axes)
     return jax.jit(fleet_scan,
                    in_shardings=(state_shardings, window_shardings),
                    out_shardings=scan_out)
 
 
-def _scan_shardings(mesh: Mesh):
+def _scan_shardings(mesh: Mesh, axes: tuple = ('pools',)):
     """Derive the [T, ...] window shardings from the per-tick specs."""
-    state, inputs, (_, outs, fleet) = _step_shardings(mesh)
+    state, inputs, (_, outs, fleet) = _step_shardings(mesh, axes)
     prepend = functools.partial(_prepend_time_axis, mesh=mesh)
     window = jax.tree.map(prepend, inputs)
     # Final carried state has no time axis; stacked outs/fleet do.
@@ -317,9 +319,10 @@ def _scan_shardings(mesh: Mesh):
                            jax.tree.map(prepend, fleet))
 
 
-def shard_window(window: FleetInputs, mesh: Mesh) -> FleetInputs:
+def shard_window(window: FleetInputs, mesh: Mesh,
+                 axes: tuple = ('pools',)) -> FleetInputs:
     """Place a [T, P] tick window onto the mesh (pools axis sharded)."""
-    _, window_shardings, _ = _scan_shardings(mesh)
+    _, window_shardings, _ = _scan_shardings(mesh, axes)
     return jax.tree.map(jax.device_put, window, window_shardings)
 
 
