@@ -603,3 +603,65 @@ def test_release_before_removed_is_a_misuse_trap():
         resolver.stop()
         await wait_for_state(cset, 'stopped')
     run_async(t())
+
+
+def test_cset_n1_replaces_dead_connection_cueball_148():
+    """Reference #148 (CHANGES.adoc v2.8.1): a set with target=1 must
+    not hold onto a dead connection — when its single advertised
+    connection dies, the logical connection is removed and a live
+    replacement is advertised."""
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(ctx, target=1, maximum=2,
+                                          retries=2, delay=5)
+        added = []
+        removed = []
+
+        def on_added(key, conn, hdl):
+            # A real consumer owns the advertised connection's error
+            # handling (reference docs/api.adoc Set contract).
+            conn.on('error', lambda e: None)
+            added.append((key, conn))
+        cset.on('added', on_added)
+
+        def on_removed(key, conn, hdl):
+            removed.append(key)
+            hdl.release()
+        cset.on('removed', on_removed)
+
+        inner.emit('added', 'b1', {})
+        inner.emit('added', 'b2', {})
+        await settle()
+        for c in list(ctx.connections):
+            if not c.connected and not c.dead:
+                c.connect()
+        await settle()
+        assert len(added) == 1, 'target=1: exactly one advertised'
+        first_key, first_conn = added[0]
+
+        # Kill the advertised connection.
+        first_conn.connected = False
+        first_conn.emit('error', RuntimeError('backend died'))
+        await settle()
+
+        # The dead logical connection must be taken back...
+        assert first_key in removed, \
+            'set held onto its dead connection (#148)'
+        # ...and a live replacement advertised (same or other backend)
+        # once its socket connects.
+        for _ in range(50):
+            for c in list(ctx.connections):
+                if not c.connected and not c.dead:
+                    c.connect()
+            if len(added) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(added) >= 2, 'no replacement advertised after death'
+        repl_key, repl_conn = added[-1]
+        assert repl_conn is not first_conn
+        assert repl_conn.connected
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())

@@ -733,3 +733,50 @@ def test_pool_creates_and_owns_its_resolver():
         assert resolver.is_in_state('stopped')
         transport.close()
     run_async(t())
+
+
+def test_resolver_removed_during_stop_no_crash_cueball_96():
+    """Reference #96: a resolver 'removed' arriving while the pool is
+    stopping (slots already winding down) must not crash the pool."""
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+
+        pool.stop()
+        # The backend disappears mid-stop.
+        inner.emit('removed', 'b1')
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
+
+
+def test_slot_retains_previous_handle_cueball_118():
+    """Reference #118: after release, the slot keeps a reference to
+    the PREVIOUS claim handle (post-mortem debugging of use-after-
+    release)."""
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=2)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+
+        fut, _ = claim(pool)
+        hdl, conn = await fut
+        hdl.release()
+        await settle()
+
+        slots = [s for ss in pool.p_connections.values() for s in ss]
+        assert any(getattr(s, 'csf_prev_handle', None) is hdl
+                   for s in slots), \
+            'slot should retain the previous claim handle (#118)'
+
+        pool.stop()
+        await wait_for_state(pool, 'stopped')
+    run_async(t())
