@@ -780,3 +780,44 @@ def test_slot_retains_previous_handle_cueball_118():
         pool.stop()
         await wait_for_state(pool, 'stopped')
     run_async(t())
+
+
+def test_pool_ctor_validation():
+    """Strict ctor asserts (reference lib/pool.js:125-183): every
+    malformed option set is rejected before any runtime state is
+    built."""
+    def base():
+        return {
+            'domain': 'svc', 'constructor': lambda b: None,
+            'spares': 1, 'maximum': 2,
+            'recovery': {'default': {'timeout': 100, 'retries': 1,
+                                     'delay': 10}},
+        }
+
+    with pytest.raises(AssertionError, match='must be a dict'):
+        ConnectionPool('nope')
+    o = base()
+    del o['constructor']
+    with pytest.raises(AssertionError, match='constructor'):
+        ConnectionPool(o)
+    o = base()
+    o['domain'] = 7
+    with pytest.raises(AssertionError, match='domain'):
+        ConnectionPool(o)
+    o = base()
+    o['spares'] = 'one'
+    with pytest.raises(AssertionError, match='spares'):
+        ConnectionPool(o)
+    o = base()
+    o['recovery'] = {}
+    with pytest.raises(AssertionError, match='recovery.default'):
+        ConnectionPool(o)
+    o = base()
+    o['recovery'] = {'default': {'timeout': 100, 'retries': 1,
+                                 'delay': 10, 'bogusKey': 1}}
+    with pytest.raises(AssertionError, match='unknown keys'):
+        ConnectionPool(o)
+    o = base()
+    o['targetClaimDelay'] = 'soon'
+    with pytest.raises(AssertionError, match='targetClaimDelay'):
+        ConnectionPool(o)
