@@ -358,3 +358,31 @@ def test_destroy_before_connect_cancels():
         assert conn.proto is None
         assert errors == []
     run_async(t())
+
+
+def test_idle_pooled_connection_death_evicted():
+    async def t():
+        # Backend FIN on an IDLE pooled connection: the
+        # _WatchedHandler must evict it so the next request rides a
+        # fresh conn with no app-visible error.
+        srv = await MiniHttpServer().start()
+        connector = CueballConnector({'spares': 1, 'maximum': 2,
+                                      'recovery': RECOVERY})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            url = 'http://127.0.0.1:%d/' % srv.port
+            async with s.get(url) as r:
+                assert r.status == 200
+            for w in list(srv._writers):
+                w.close()
+            deadline = time.monotonic() + 5
+            ok = False
+            while time.monotonic() < deadline and not ok:
+                try:
+                    async with s.get(url) as r:
+                        ok = r.status == 200
+                except aiohttp.ClientError:
+                    await asyncio.sleep(0.05)
+            assert ok, \
+                'request after idle-death should succeed on fresh conn'
+        srv.close()
+    run_async(t())

@@ -395,3 +395,37 @@ def test_closed_transport_refuses_requests():
             await transport.handle_async_request(req)
         await transport.aclose()   # idempotent
     run_async(t())
+
+
+def test_idle_pooled_connection_death_evicted():
+    async def t():
+        # A backend FIN on an IDLE pooled connection must evict it
+        # (the _WatchedProtocol design): the next request gets a fresh
+        # conn, no error surfaces to the app.
+        srv = await MiniHttpServer().start()
+        transport = CueballTransport({'spares': 1, 'maximum': 2,
+                                      'recovery': RECOVERY})
+        async with httpx.AsyncClient(transport=transport) as client:
+            base = 'http://127.0.0.1:%d' % srv.port
+            r = await asyncio.wait_for(client.get(base + '/'), 5)
+            assert r.status_code == 200
+            # Sever every server-side socket while the pool's conns
+            # sit idle.
+            for w in list(srv._writers):
+                w.close()
+            # Deadline loop, not a fixed sleep: under CI load the
+            # eviction callback may run late (the failover test's
+            # established pattern).
+            deadline = time.monotonic() + 5
+            ok = False
+            while time.monotonic() < deadline and not ok:
+                try:
+                    r = await asyncio.wait_for(
+                        client.get(base + '/'), 5)
+                    ok = r.status_code == 200
+                except httpx.TransportError:
+                    await asyncio.sleep(0.05)
+            assert ok, \
+                'request after idle-death should succeed on fresh conn'
+        srv.close()
+    run_async(t())
