@@ -303,3 +303,107 @@ class CueballTransport(httpx.AsyncBaseTransport):
         for agent in agents:
             if not agent.is_stopped():
                 await agent.stop()
+
+
+class CueballSyncTransport(httpx.BaseTransport):
+    """The synchronous twin of :class:`CueballTransport`: a stock
+    *sync* ``httpx.Client`` adopts cueball pools with one argument::
+
+        client = httpx.Client(transport=CueballSyncTransport({...}))
+
+    cueball's FSMs live on an asyncio loop; this transport owns a
+    dedicated background loop thread and bridges each request onto it
+    with ``run_coroutine_threadsafe``. Many sync threads may share one
+    transport — their requests serialize onto the single loop thread,
+    where the usual pool concurrency (spares, claims, failover,
+    CoDel) applies exactly as in the async form. Options, lifecycle
+    mapping, timeout semantics and error translation are all
+    :class:`CueballTransport`'s."""
+
+    def __init__(self, options: dict | None = None):
+        import threading
+        self._async = CueballTransport(options)
+        self._loop = asyncio.new_event_loop()
+        self._closing = False
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+            self._loop.call_soon(started.set)
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(
+            target=run, name='cueball-httpx-sync', daemon=True)
+        self._thread.start()
+        started.wait()
+
+    @property
+    def async_transport(self) -> CueballTransport:
+        """The underlying async transport (pre-create pools / read
+        stats through its agents — but call its methods only from the
+        transport's own loop thread, e.g. via :meth:`call`)."""
+        return self._async
+
+    def call(self, fn, *args, **kwargs):
+        """Run ``fn(*args, **kwargs)`` on the transport's loop thread
+        and return its result (awaiting it first if fn returns an
+        awaitable). Needed for anything that constructs cueball FSMs —
+        resolvers, ``create_pool`` — since those require a running
+        loop::
+
+            transport.call(
+                lambda: transport.async_transport.agent_for('http')
+                .create_pool('svc', {'resolver': make_resolver()}))
+        """
+        import inspect
+
+        async def wrapper():
+            result = fn(*args, **kwargs)
+            if inspect.isawaitable(result):
+                result = await result
+            return result
+        return asyncio.run_coroutine_threadsafe(
+            wrapper(), self._loop).result()
+
+    def handle_request(self, request: httpx.Request) -> httpx.Response:
+        import concurrent.futures
+        if self._closing or self._loop.is_closed():
+            # Same error class as the async twin's closed check, so
+            # httpx-targeted error handling behaves identically.
+            raise httpx.TransportError('CueballTransport is closed')
+        # Load the (possibly iterator) sync body here, on the calling
+        # thread: afterwards the request carries a ByteStream, which
+        # serves the async path's aread() too.
+        request.read()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._async.handle_async_request(request), self._loop)
+        try:
+            while True:
+                try:
+                    # Bounded waits, re-checking liveness: a request
+                    # that slipped past the closed check while another
+                    # thread ran close() must error, not hang on a
+                    # stopped loop.
+                    return fut.result(timeout=0.5)
+                except concurrent.futures.TimeoutError:
+                    if self._closing or self._loop.is_closed():
+                        fut.cancel()
+                        raise httpx.TransportError(
+                            'CueballTransport is closed') from None
+        except BaseException:
+            # Caller-side unwind (KeyboardInterrupt, thread teardown):
+            # cancel the in-flight coroutine so its claim is released
+            # — the sync analogue of the async path's CancelledError
+            # -> handle.close() mapping.
+            fut.cancel()
+            raise
+
+    def close(self) -> None:
+        if self._closing or self._loop.is_closed():
+            return
+        self._closing = True
+        asyncio.run_coroutine_threadsafe(
+            self._async.aclose(), self._loop).result()
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join()
+        self._loop.close()

@@ -429,3 +429,126 @@ def test_idle_pooled_connection_death_evicted():
                 'request after idle-death should succeed on fresh conn'
         srv.close()
     run_async(t())
+
+
+# ---------------------------------------------------------------------------
+# CueballSyncTransport: the synchronous twin (background loop thread)
+
+def test_sync_client_one_line_adoption():
+    from cueball_tpu.integrations.httpx import CueballSyncTransport
+
+    async def start_srv():
+        return await MiniHttpServer().start()
+    # The server needs a loop of its own; reuse the transport's.
+    transport = CueballSyncTransport({'spares': 1, 'maximum': 2,
+                                      'recovery': RECOVERY})
+    srv = asyncio.run_coroutine_threadsafe(
+        start_srv(), transport._loop).result()
+    try:
+        with httpx.Client(transport=transport) as client:
+            for _ in range(4):
+                r = client.get('http://127.0.0.1:%d/x' % srv.port)
+                assert r.status_code == 200
+                assert r.text == 'hello from %d' % srv.port
+            pool = transport.call(
+                lambda: transport.async_transport.agent_for('http')
+                .pools['127.0.0.1:%d' % srv.port])
+            assert transport.call(
+                lambda: pool.get_stats()['totalConnections']) <= 2
+            transport.call(srv.close)
+    finally:
+        if not transport._loop.is_closed():
+            transport.call(srv.close)
+            transport.close()
+    assert transport._loop.is_closed()   # Client close tore it down
+
+
+def test_sync_client_concurrent_threads():
+    import concurrent.futures
+
+    from cueball_tpu.integrations.httpx import CueballSyncTransport
+
+    async def start_srv():
+        return await MiniHttpServer().start()
+    transport = CueballSyncTransport({'spares': 2, 'maximum': 4,
+                                      'recovery': RECOVERY})
+    srv = asyncio.run_coroutine_threadsafe(
+        start_srv(), transport._loop).result()
+    try:
+        client = httpx.Client(transport=transport)
+
+        def worker(_):
+            r = client.get('http://127.0.0.1:%d/' % srv.port)
+            assert r.status_code == 200
+            return r.text
+
+        with concurrent.futures.ThreadPoolExecutor(6) as ex:
+            results = list(ex.map(worker, range(24)))
+        assert len(results) == 24
+        assert all(t == 'hello from %d' % srv.port for t in results)
+        transport.call(srv.close)
+        client.close()
+    finally:
+        if not transport._loop.is_closed():
+            transport.close()
+
+
+def test_sync_client_refused_fast_fail_and_precreated_pool():
+    from cueball_tpu.integrations.httpx import CueballSyncTransport
+
+    transport = CueballSyncTransport({'spares': 1, 'maximum': 2,
+                                      'recovery': FAST_RECOVERY})
+
+    async def start_srv():
+        return await MiniHttpServer().start()
+    srv = asyncio.run_coroutine_threadsafe(
+        start_srv(), transport._loop).result()
+    try:
+        with httpx.Client(transport=transport,
+                          timeout=httpx.Timeout(5.0, pool=0.8)) as c:
+            t0 = time.monotonic()
+            with pytest.raises((httpx.ConnectError,
+                                httpx.PoolTimeout)):
+                c.get('http://127.0.0.1:1/')
+            assert time.monotonic() - t0 < 1.5
+
+            # Pre-created custom-resolver pool through call().
+            transport.call(
+                lambda: transport.async_transport.agent_for('http')
+                .create_pool('svc.sync', {'resolver': StaticIpResolver(
+                    {'backends': [{'address': '127.0.0.1',
+                                   'port': srv.port}]})}))
+            r = c.get('http://svc.sync/')
+            assert r.status_code == 200
+            transport.call(srv.close)
+    finally:
+        if not transport._loop.is_closed():
+            transport.close()
+
+
+def test_sync_transport_closed_raises_not_hangs():
+    from cueball_tpu.integrations.httpx import CueballSyncTransport
+
+    transport = CueballSyncTransport({'recovery': RECOVERY})
+    transport.close()
+    transport.close()   # idempotent
+    with pytest.raises(httpx.TransportError):
+        transport.handle_request(
+            httpx.Request('GET', 'http://127.0.0.1:1/'))
+
+
+def test_sync_transport_call_awaits_awaitables():
+    from cueball_tpu.integrations.httpx import CueballSyncTransport
+
+    transport = CueballSyncTransport({'recovery': RECOVERY})
+    try:
+        # Plain values pass through...
+        assert transport.call(lambda: 41 + 1) == 42
+        # ...and awaitables are awaited, not returned as raw
+        # coroutine objects.
+        async def answer():
+            await asyncio.sleep(0)
+            return 'done'
+        assert transport.call(answer) == 'done'
+    finally:
+        transport.close()
