@@ -26,8 +26,12 @@ class CodelState(typing.NamedTuple):
 
 
 def codel_init(num_queues: int) -> CodelState:
-    z = jnp.zeros((num_queues,), jnp.float32)
-    return CodelState(z, z, z, jnp.zeros((num_queues,), bool))
+    # Three separate allocations, NOT one aliased zeros array: the
+    # live sampler donates this state through its jitted step, and XLA
+    # rejects donating the same underlying buffer twice.
+    def z():
+        return jnp.zeros((num_queues,), jnp.float32)
+    return CodelState(z(), z(), z(), jnp.zeros((num_queues,), bool))
 
 
 def _step(target: jax.Array, state: CodelState, inputs):

@@ -16,12 +16,12 @@ monitor each LP tick and publishes the batched decisions.
 from .sampler import FleetSampler
 from .telemetry import (FleetInputs, FleetState, fleet_init,
                         fleet_inputs, fleet_scan, fleet_step,
-                        make_sharded_scan, make_sharded_step,
-                        make_shardmap_step, shard_inputs, shard_state,
-                        shard_window)
+                        make_live_step, make_sharded_scan,
+                        make_sharded_step, make_shardmap_step,
+                        shard_inputs, shard_state, shard_window)
 
 __all__ = ['FleetInputs', 'FleetSampler', 'FleetState', 'fleet_init',
            'fleet_inputs', 'fleet_scan', 'fleet_step',
-           'make_sharded_scan', 'make_sharded_step',
+           'make_live_step', 'make_sharded_scan', 'make_sharded_step',
            'make_shardmap_step', 'shard_inputs', 'shard_state',
            'shard_window']

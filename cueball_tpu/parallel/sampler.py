@@ -74,6 +74,14 @@ class FleetSampler:
       only *uses* the advisory if it was itself constructed with
       fleetActuation=True — both ends opt in, so turning the sampler
       flag on over a fleet of stock pools changes nothing.
+    - mesh: a jax.sharding.Mesh. When given, the fleet arrays live
+      sharded over the mesh (same layouts as make_sharded_step) and
+      the tick step is the sharded one, so the published aggregates
+      compile to all-reduces over ICI. Row capacity rounds up to a
+      multiple of the mesh size. The snapshot()/``/kang/fleet``
+      surface reports the mesh shape.
+    - meshAxes: mesh axis name(s) the pools axis shards over
+      (default ('pools',); pass ('host', 'chip') for a 2-D mesh).
     """
 
     def __init__(self, options: dict | None = None):
@@ -85,6 +93,16 @@ class FleetSampler:
         self.fs_collector: 'Collector | None' = options.get('collector')
         self.fs_record = bool(options.get('record'))
         self.fs_actuate = bool(options.get('actuate'))
+        self.fs_mesh = options.get('mesh')
+        self.fs_mesh_axes = tuple(options.get('meshAxes') or ('pools',))
+        if self.fs_mesh is not None:
+            # Shard layouts need the pools axis divisible by the mesh;
+            # doubling growth preserves any starting multiple.
+            n = int(self.fs_mesh.size)
+            self.fs_capacity = -(-self.fs_capacity // n) * n
+        self.fs_step = None                    # jitted tick step (lazy)
+        self.fs_input_shardings = None         # FleetInputs of shardings
+        self.fs_input_cache: dict[str, tuple] = {}  # field -> (host, dev)
 
         self.fs_epoch = mod_utils.current_millis()
         self.fs_rows: dict[str, int] = {}      # pool uuid -> row
@@ -118,15 +136,25 @@ class FleetSampler:
     # -- row management --------------------------------------------------
 
     def _ensure_state(self):
-        from .telemetry import fleet_init
+        from .telemetry import (_step_shardings, fleet_init,
+                                make_live_step, shard_state)
         if self.fs_state is None:
             self.fs_state = fleet_init(self.fs_capacity, taps=self.fs_taps)
+            if self.fs_mesh is not None:
+                self.fs_state = shard_state(
+                    self.fs_state, self.fs_mesh, self.fs_mesh_axes)
+                _, self.fs_input_shardings, _ = _step_shardings(
+                    self.fs_mesh, self.fs_mesh_axes)
+            # State buffers are donated through the step, so they stay
+            # device-resident and get rewritten in place every tick.
+            self.fs_step = make_live_step(self.fs_mesh,
+                                          self.fs_mesh_axes)
         return self.fs_state
 
     def _grow(self, need: int) -> None:
         import jax.numpy as jnp
         from ..ops.codel_batch import CodelState
-        from .telemetry import FleetState
+        from .telemetry import FleetState, shard_state
         old = self.fs_capacity
         cap = old
         while cap < need:
@@ -141,6 +169,10 @@ class FleetSampler:
                 count=jnp.pad(st.codel.count, (0, pad)),
                 dropping=jnp.pad(st.codel.dropping, (0, pad))),
             now_ms=st.now_ms)
+        if self.fs_mesh is not None:
+            self.fs_state = shard_state(
+                self.fs_state, self.fs_mesh, self.fs_mesh_axes)
+        self.fs_input_cache.clear()   # shapes changed
         self.fs_free.extend(range(old, cap))
         self.fs_capacity = cap
 
@@ -205,11 +237,38 @@ class FleetSampler:
             'retry_attempt': attempt, 'n_retrying': float(n_retrying),
         }
 
+    def _place_inputs(self, arrays: dict, now: float):
+        """Host tick columns -> device FleetInputs, re-shipping only
+        the fields whose values changed since the previous tick.
+
+        Most per-pool fields are static between ticks (spares, maximum,
+        CoDel targets, the retry ladder when nothing is failing); over
+        a tunneled chip every avoided host->device transfer is an RTT
+        saved, so unchanged columns reuse their committed device array
+        from the last tick. The scalar clock always changes and always
+        ships."""
+        import jax
+        import numpy as np
+        from .telemetry import FleetInputs
+        placed = {}
+        for name, host in arrays.items():
+            cached = self.fs_input_cache.get(name)
+            if cached is not None and np.array_equal(cached[0], host):
+                placed[name] = cached[1]
+                continue
+            if self.fs_input_shardings is not None:
+                dev = jax.device_put(
+                    host, getattr(self.fs_input_shardings, name))
+            else:
+                dev = jax.device_put(host)
+            self.fs_input_cache[name] = (host, dev)
+            placed[name] = dev
+        return FleetInputs(now_ms=np.float32(now), **placed)
+
     def sample_once(self) -> dict | None:
         """One synchronous tick: gather, step, publish. Returns the
         published record (None when sampling is impossible)."""
         import numpy as np
-        from .telemetry import FleetInputs, fleet_step
 
         pools = dict(self.fs_monitor.pm_pools)
         self._assign_rows(pools)
@@ -250,10 +309,25 @@ class FleetSampler:
             cols['retry_attempt'][row] = g['retry_attempt']
             cols['n_retrying'][row] = g['n_retrying']
 
-        inp = FleetInputs(active=active, reset=reset,
-                          now_ms=np.float32(now), **cols)
         state = self._ensure_state()
-        new_state, out, fleet = fleet_step(state, inp)
+        inp = self._place_inputs(
+            dict(active=active, reset=reset, **cols), now)
+        try:
+            new_state, out, fleet = self.fs_step(state, inp)
+        except Exception:
+            # Donation marks the carried buffers deleted at dispatch,
+            # BEFORE a runtime failure surfaces — retrying against
+            # them would raise "Array has been deleted" on every tick
+            # forever. Recover like a sampler restart: drop the state
+            # (re-init next tick), flag every occupied row for reset,
+            # and restart the actuation warm-up gates; then let the
+            # error propagate to the timer's handler.
+            self.fs_state = None
+            self.fs_input_cache.clear()
+            for row in self.fs_rows.values():
+                self.fs_pending_reset.add(row)
+                self.fs_row_ticks[row] = 0
+            raise
         self.fs_state = new_state
         self.fs_ticks += 1
 
@@ -311,12 +385,22 @@ class FleetSampler:
     # -- kang integration ------------------------------------------------
 
     def snapshot(self) -> dict:
+        mesh = None
+        if self.fs_mesh is not None:
+            mesh = {
+                'axes': list(self.fs_mesh_axes),
+                'shape': {str(k): int(v) for k, v in zip(
+                    self.fs_mesh.axis_names,
+                    self.fs_mesh.devices.shape)},
+                'n_devices': int(self.fs_mesh.size),
+            }
         return {
             'interval_ms': self.fs_interval,
             'capacity': self.fs_capacity,
             'ticks': self.fs_ticks,
             'rows': dict(self.fs_rows),
             'actuate': self.fs_actuate,
+            'mesh': mesh,
             'row_ticks': dict(self.fs_row_ticks),
             'latest': self.fs_latest,
         }
