@@ -277,46 +277,163 @@ def _default_is_pallas():
     return _default_fir() is fir_apply_pallas
 
 
-def bench_telemetry_step():
-    """Jitted fleet-telemetry step rate on the attached accelerator,
-    measured for BOTH FIR code paths — the XLA einsum default and the
-    hand-written pallas kernel — so the kept default is the measured
-    winner (VERDICT r2 item 4)."""
-    try:
-        import jax
-    except ImportError:
-        return None, None, None, None, None
-    from __graft_entry__ import entry
-    from cueball_tpu.parallel.telemetry import (fleet_step_pallas,
-                                                fleet_step_xla)
-    _, args = entry()
+# Chip-stage shapes. Full size matches the BENCH_TPU.json protocol so
+# rounds stay comparable; the small stage exists to land a number
+# within seconds even when the tunnel wedges mid-run.
+TELEM_POOLS = 1 << 20
+TELEM_SMALL = 1 << 16
+TELEM_TICK_SIZES = (1024, 10240, 102400)
 
-    def rate(step):
-        out = step(*args)
-        jax.block_until_ready(out)  # compile
-        iters = 200
+# The code whose behavior the chip numbers measure: the kernels, the
+# batched laws + shardings, the entry shapes, AND the live sampler +
+# monitor (the tick_cost stages time FleetSampler.sample_once end to
+# end). The protocol shapes are folded in separately below so a shape
+# change stales the artifact without hashing all of bench.py.
+_TELEM_CODE = ('cueball_tpu/ops', 'cueball_tpu/parallel/telemetry.py',
+               'cueball_tpu/parallel/sampler.py',
+               'cueball_tpu/monitor.py', '__graft_entry__.py')
+
+
+def telemetry_code_hash() -> str:
+    """Content hash of the measured code paths + protocol shapes.
+
+    Recorded into BENCH_TPU.json at capture time; a bench run that
+    cannot reach the chip refuses to cite an artifact whose hash no
+    longer matches the working tree, so a stale chip number cannot
+    outlive the code (or protocol) it measured (VERDICT r4 weak #3)."""
+    import hashlib
+    root = os.path.dirname(os.path.abspath(__file__))
+    paths = []
+    for rel in _TELEM_CODE:
+        p = os.path.join(root, rel)
+        if os.path.isdir(p):
+            paths.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith('.py')))
+        else:
+            paths.append(p)
+    h = hashlib.sha256()
+    for p in paths:
+        h.update(os.path.relpath(p, root).encode())
+        with open(p, 'rb') as f:
+            h.update(f.read())
+    h.update(repr((TELEM_POOLS, TELEM_SMALL,
+                   TELEM_TICK_SIZES)).encode())
+    return h.hexdigest()[:16]
+
+
+class _BenchPool:
+    """The minimal pool surface FleetSampler.gather_pool reads, so the
+    tick-cost stage can weigh the REAL sampler path (Python gather +
+    placement + donated step + publish) at fleet sizes no process
+    would build real pools for."""
+
+    __slots__ = ('p_uuid', 'p_spares', 'p_max', 'p_codel', 'p_waiters',
+                 'p_connections', 'load')
+
+    def __init__(self, i):
+        self.p_uuid = 'bench-%d' % i
+        self.p_spares = 2
+        self.p_max = 16
+        self.p_codel = None
+        self.p_waiters = ()
+        self.p_connections = {}
+        self.load = float(i % 8)
+
+    def lp_load_sample(self):
+        return self.load
+
+
+def bench_telemetry_stages(emit, pools=TELEM_POOLS):
+    """The chip benchmark as resumable sub-stages, cheapest first.
+
+    Calls emit(dict) the moment each stage lands, so a tunnel that
+    wedges mid-run still leaves every completed number on disk (the
+    child appends them to a progress file the parent reads back even
+    after killing it). Stage list:
+
+    - device:          backend probe (proves the tunnel answered)
+    - dispatch_floor:  chained per-call latency of a trivial jitted op
+                       — the per-tick overhead no step can go below
+    - step_small:      donated live step at 64k pools (seconds-scale)
+    - step_live:       donated live step, state fed back, at 1M pools
+                       — the FleetSampler's actual per-tick form
+    - step_xla/pallas: undonated same-args form for both FIR paths
+                       (comparable with prior rounds' artifacts)
+    - scan:            64-tick lax.scan window replay
+    - tick_cost_N:     wall us/tick of a real FleetSampler.sample_once
+                       over N synthetic pools, with the Python gather
+                       loop timed separately (gather_us_N)
+    """
+    import jax
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    emit({'stage': 'device', 'device': str(jax.devices()[0]),
+          'backend': jax.default_backend()})
+
+    from __graft_entry__ import _example_inputs
+    from cueball_tpu.parallel.telemetry import (fleet_scan,
+                                                fleet_step_pallas,
+                                                fleet_step_xla,
+                                                make_live_step)
+
+    # Chained trivial op: the per-execute floor (dispatch + one device
+    # round of a no-work program). The live step chains its state the
+    # same way, so step_time ~ floor_time means dispatch-bound.
+    tiny = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros((8,), jnp.float32)
+    jax.block_until_ready(tiny(x))
+    iters = 200
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        x = tiny(x)
+    jax.block_until_ready(x)
+    emit({'stage': 'dispatch_floor',
+          'dispatch_floor_us': (time.perf_counter() - t0) / iters * 1e6})
+
+    live = make_live_step()
+
+    def live_rate(n, iters):
+        state, inp = _example_inputs(n)
+        out = live(state, inp)           # compile + donate the init
+        jax.block_until_ready(out)
+        state = out[0]
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = step(*args)
-        jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
-        return args[1].samples.shape[0] * iters / dt
+            state, _out, _fleet = live(state, inp)
+        jax.block_until_ready(state)
+        return n * iters / (time.perf_counter() - t0)
 
-    xla_rate = rate(fleet_step_xla)
+    emit({'stage': 'step_small', 'small_pools': TELEM_SMALL,
+          'small_pools_per_sec': live_rate(TELEM_SMALL, 100)})
+    emit({'stage': 'step_live', 'pools': pools,
+          'pools_per_sec_live': live_rate(pools, 50)})
+
+    state, inp = _example_inputs(pools)
+
+    def rate(step, iters=20):
+        out = step(state, inp)
+        jax.block_until_ready(out)       # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(state, inp)
+        jax.block_until_ready(out)
+        return pools * iters / (time.perf_counter() - t0)
+
+    emit({'stage': 'step_xla', 'pools_per_sec_xla': rate(fleet_step_xla)})
     try:
         pallas_rate = rate(fleet_step_pallas)
     except Exception:      # pallas unavailable on this backend
         pallas_rate = None
+    emit({'stage': 'step_pallas', 'pools_per_sec_pallas': pallas_rate,
+          'default_is_pallas': _default_is_pallas()})
 
     # Offline-replay form: one lax.scan call per 64-tick window
     # (amortizes per-step dispatch; telemetry.fleet_scan).
-    import jax.numpy as jnp
-    import jax.tree_util as jtu
-    from cueball_tpu.parallel.telemetry import fleet_scan
-    state, inp = args
     T = 64
     window = jtu.tree_map(
-        lambda x: jnp.broadcast_to(x, (T,) + x.shape), inp)
+        lambda a: jnp.broadcast_to(a, (T,) + a.shape), inp)
     window = window._replace(
         now_ms=inp.now_ms + 100.0 * jnp.arange(T, dtype=jnp.float32))
     out = fleet_scan(state, window)
@@ -326,15 +443,91 @@ def bench_telemetry_step():
     for _ in range(iters):
         out = fleet_scan(state, window)
     jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    scan_rate = inp.samples.shape[0] * T * iters / dt
+    emit({'stage': 'scan', 'pools_per_sec_scan':
+          pools * T * iters / (time.perf_counter() - t0)})
 
-    return (xla_rate, pallas_rate, scan_rate, str(jax.devices()[0]),
-            _default_is_pallas())
+    # Live sampler tick cost: what one FleetSampler.sample_once costs
+    # end to end (VERDICT r4 item 2), gather decomposed out.
+    from cueball_tpu.monitor import PoolMonitor
+    from cueball_tpu.parallel.sampler import FleetSampler
+    from cueball_tpu.utils import current_millis
+    sizes = TELEM_TICK_SIZES
+    if os.environ.get('CUEBALL_BENCH_TICKS'):
+        sizes = tuple(int(v) for v in
+                      os.environ['CUEBALL_BENCH_TICKS'].split(','))
+    emit({'stage': 'tick_sizes', 'tick_sizes': list(sizes)})
+    for n in sizes:
+        mon = PoolMonitor()
+        fleet = [_BenchPool(i) for i in range(n)]
+        for p in fleet:
+            mon.register_pool(p)
+        s = FleetSampler({'monitor': mon, 'capacity': n})
+        s.sample_once()                  # compile
+        s.sample_once()                  # warm transfer cache
+        iters = 5
+        t0 = time.perf_counter()
+        for k in range(iters):
+            for p in fleet[::97]:        # loads move between ticks
+                p.load = float((p.load + k + 1) % 8)
+            s.sample_once()
+        tick_us = (time.perf_counter() - t0) / iters * 1e6
+        now = current_millis()
+        t0 = time.perf_counter()
+        for p in fleet:
+            FleetSampler.gather_pool(p, now)
+        gather_us = (time.perf_counter() - t0) * 1e6
+        emit({'stage': 'tick_cost_%d' % n,
+              'tick_us_%d' % n: tick_us,
+              'gather_us_%d' % n: gather_us})
 
 
-def bench_telemetry_step_guarded(timeout_s: float = 300.0):
-    """bench_telemetry_step in a KILLABLE subprocess with a watchdog.
+def _telemetry_child_main(progress_path: str) -> None:
+    """Child-process entry: run the stages against the real backend,
+    appending each stage to the progress file as it lands."""
+    import sys
+    # Undo the parent's single-core pin (inherited): XLA wants its
+    # compile/runtime threads spread over every core.
+    try:
+        os.sched_setaffinity(0, range(os.cpu_count() or 1))
+    except (AttributeError, OSError):
+        pass
+    import jax
+    # The container sitecustomize force-registers the TPU backend,
+    # overriding JAX_PLATFORMS=cpu; honor an explicit CPU request
+    # (CI exercise of the staged path) via jax.config instead.
+    if 'cpu' in (os.environ.get('JAX_PLATFORMS') or ''):
+        try:
+            jax.config.update('jax_platforms', 'cpu')
+        except RuntimeError:
+            pass
+    # Persistent compilation cache: a retry after a wedged/killed run
+    # (or the driver's run after a capture) skips the 20-40 s
+    # compiles entirely.
+    try:
+        jax.config.update(
+            'jax_compilation_cache_dir',
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         '.jax_cache'))
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          0.0)
+    except Exception as e:  # cache is an optimization, never fatal
+        print('bench: no compile cache (%s)' % e, file=sys.stderr)
+    # Shape overrides for fast CI exercise of the staged path (the
+    # committed artifacts always use the defaults).
+    pools = int(os.environ.get('CUEBALL_BENCH_POOLS') or TELEM_POOLS)
+    acc = {}
+    with open(progress_path, 'a', encoding='utf-8') as pf:
+        def emit(stage: dict) -> None:
+            acc.update(
+                {k: v for k, v in stage.items() if k != 'stage'})
+            pf.write(json.dumps(stage) + '\n')
+            pf.flush()
+        bench_telemetry_stages(emit, pools=pools)
+    print(json.dumps(acc))
+
+
+def bench_telemetry_step_guarded(timeout_s: float = 300.0) -> dict:
+    """The staged chip benchmark in a KILLABLE subprocess.
 
     Two reasons it must be a subprocess, not a thread: TPU backend
     acquisition over the chip tunnel can wedge indefinitely (observed:
@@ -342,46 +535,101 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0):
     killed; and when the tunnel is wedged, the axon machinery's retry
     threads contend with the host benchmarks for the GIL (observed
     halving claim throughput), so the main bench process pins itself to
-    CPU (see main()) and only this child ever touches the chip."""
+    CPU (see main()) and only this child ever touches the chip.
+
+    Every stage the child completed before a timeout/crash is read
+    back from the progress file, so a wedge loses the remaining
+    stages, not the evidence. Returns a flat dict of stage fields plus
+    'stages_completed' and, on failure, 'error'."""
     import subprocess
     import sys
-    code = (
-        'import json, os, sys\n'
-        # Undo the parent's single-core pin (inherited): XLA wants its
-        # compile/runtime threads spread over every core.
-        'try:\n'
-        '    os.sched_setaffinity(0, range(os.cpu_count() or 1))\n'
-        'except (AttributeError, OSError):\n'
-        '    pass\n'
-        "sys.path.insert(0, %r)\n"
-        'import bench\n'
-        'xla, pallas, scan, dev, is_pallas = bench.bench_telemetry_step()\n'
-        'print(json.dumps([xla, pallas, scan, dev, is_pallas]))\n'
-    ) % os.path.dirname(os.path.abspath(__file__))
+    import tempfile
+    root = os.path.dirname(os.path.abspath(__file__))
+    fd, progress = tempfile.mkstemp(prefix='bench_telem_',
+                                    suffix='.jsonl')
+    os.close(fd)
+    code = ('import sys; sys.path.insert(0, %r); import bench; '
+            'bench._telemetry_child_main(%r)' % (root, progress))
+    err = None
     try:
         r = subprocess.run([sys.executable, '-c', code],
                            capture_output=True, text=True,
                            timeout=timeout_s)
+        if r.returncode != 0:
+            # Distinguish a broken bench path from a missing
+            # accelerator in the JSON itself (a null rate alone would
+            # mask regressions).
+            err = 'telemetry stage failed: %s' % (
+                r.stderr.strip().splitlines()[-1] if r.stderr.strip()
+                else 'exit %d' % r.returncode)
     except subprocess.TimeoutExpired:
         err = ('telemetry stage timed out after %gs (accelerator '
                'unavailable)' % timeout_s)
-        print('bench: %s; reporting host metrics only' % err,
-              file=sys.stderr)
-        # None (JSON null) = not measured, as distinct from a measured
-        # einsum default.
-        return None, None, None, None, None, err
-    if r.returncode != 0:
-        # Distinguish a broken bench path from a missing accelerator in
-        # the JSON itself (a null rate alone would mask regressions).
-        err = 'telemetry stage failed: %s' % (
-            r.stderr.strip().splitlines()[-1] if r.stderr.strip()
-            else 'exit %d' % r.returncode)
-        print('bench: %s; reporting host metrics only' % err,
-              file=sys.stderr)
-        return None, None, None, None, None, err
-    xla, pallas, scan, dev, is_pallas = \
-        json.loads(r.stdout.strip().splitlines()[-1])
-    return xla, pallas, scan, dev, is_pallas, None
+    acc = {}
+    stages = []
+    try:
+        with open(progress, encoding='utf-8') as f:
+            for line in f:
+                d = json.loads(line)
+                stages.append(d.pop('stage', None))
+                acc.update(d)
+    except (OSError, ValueError):
+        pass
+    finally:
+        try:
+            os.unlink(progress)
+        except OSError:
+            pass
+    acc['stages_completed'] = stages
+    if err is not None:
+        acc['error'] = err
+        print('bench: %s; %d chip stage(s) landed before that' % (
+            err, len(stages)), file=sys.stderr)
+    return acc
+
+
+def _r(v, nd=1):
+    """round() that passes None through (unmeasured stage)."""
+    return None if v is None else round(v, nd)
+
+
+def artifact_citation(root: str | None = None) -> dict:
+    """When a run can't reach the chip, point at the committed chip
+    artifact — but ONLY if its recorded code hash still matches the
+    working tree. A chip number must not outlive the code it measured
+    (VERDICT r4 weak #3): a hash mismatch yields an explicit refusal,
+    never stale numbers."""
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(root, 'BENCH_TPU.json'),
+                  encoding='utf-8') as f:
+            art = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    head = telemetry_code_hash()
+    if art.get('code_hash') != head:
+        return {'telemetry_artifact_stale': {
+            'file': 'BENCH_TPU.json',
+            'artifact_code_hash': art.get('code_hash'),
+            'head_code_hash': head,
+            'note': ('refusing to cite: the artifact was captured '
+                     'from different measured-path code than the '
+                     'working tree'),
+        }}
+    return {'telemetry_committed_artifact': {
+        'file': 'BENCH_TPU.json',
+        'date': art.get('date'),
+        'device': art.get('device'),
+        'code_hash': art.get('code_hash'),
+        'telemetry_pools_per_sec_live':
+            art.get('telemetry_pools_per_sec_live'),
+        'telemetry_pools_per_sec_pallas':
+            art.get('telemetry_pools_per_sec_pallas'),
+        'telemetry_pools_per_sec_xla':
+            art.get('telemetry_pools_per_sec_xla'),
+        'telemetry_pools_per_sec_scan':
+            art.get('telemetry_pools_per_sec_scan'),
+    }}
 
 
 async def main():
@@ -408,8 +656,7 @@ async def main():
     (claim_mean, claim_stdev, claim_trials,
      claim_diags) = await bench_claim_throughput()
     queued_mean, queued_stdev = await bench_queued_claim_throughput()
-    (telem_xla, telem_pallas, telem_scan, device, default_is_pallas,
-     telem_err) = bench_telemetry_step_guarded()
+    telem = bench_telemetry_step_guarded()
 
     result = {
         'metric': 'codel_claim_delay_abs_error_ms',
@@ -430,49 +677,39 @@ async def main():
         'claim_queued_stdev': round(queued_stdev, 1),
         'claim_queued_protocol': '%d trials x %d ops, %d outstanding' % (
             CLAIM_TRIALS, QUEUED_OPS_PER_TRIAL, QUEUED_OUTSTANDING),
-        # Headline = the rate of the path _default_fir actually ships
-        # on the SUBPROCESS's backend (pallas on TPU, einsum
-        # elsewhere) — asked in the child, which sees the real chip;
-        # this parent is CPU-pinned so asking here would always say
-        # einsum (ADVICE r3).
-        'telemetry_pools_per_sec': round(
-            telem_pallas if (telem_pallas is not None and
-                             default_is_pallas) else telem_xla, 1)
-        if telem_xla else None,
-        'telemetry_default_is_pallas': default_is_pallas,
-        'telemetry_pools_per_sec_xla': round(telem_xla, 1)
-        if telem_xla else None,
-        'telemetry_pools_per_sec_pallas': round(telem_pallas, 1)
-        if telem_pallas else None,
-        'telemetry_pools_per_sec_scan': round(telem_scan, 1)
-        if telem_scan else None,
-        'device': device,
+        # Headline = the donated live-step rate (the FleetSampler's
+        # actual per-tick form) on the subprocess's real backend, with
+        # the shipped FIR path (_default_fir, asked in the child —
+        # this parent is CPU-pinned, ADVICE r3).
+        'telemetry_pools_per_sec': _r(telem.get('pools_per_sec_live')),
+        'telemetry_default_is_pallas': telem.get('default_is_pallas'),
+        'telemetry_pools_per_sec_xla': _r(
+            telem.get('pools_per_sec_xla')),
+        'telemetry_pools_per_sec_pallas': _r(
+            telem.get('pools_per_sec_pallas')),
+        'telemetry_pools_per_sec_scan': _r(
+            telem.get('pools_per_sec_scan')),
+        'telemetry_small_pools_per_sec': _r(
+            telem.get('small_pools_per_sec')),
+        'telemetry_dispatch_floor_us': _r(
+            telem.get('dispatch_floor_us')),
+        # Keyed from the child's own emitted fields (it may have run
+        # with CUEBALL_BENCH_TICKS-overridden sizes).
+        'telemetry_tick_cost_us': {
+            k[len('tick_us_'):]: _r(v) for k, v in telem.items()
+            if k.startswith('tick_us_')},
+        'telemetry_gather_us': {
+            k[len('gather_us_'):]: _r(v) for k, v in telem.items()
+            if k.startswith('gather_us_')},
+        'telemetry_stages_completed': telem.get('stages_completed'),
+        'telemetry_code_hash': telemetry_code_hash(),
+        'device': telem.get('device'),
         'targets_ms': TARGETS,
     }
-    if telem_err is not None:
-        result['telemetry_error'] = telem_err
-        # The chip tunnel wedges intermittently (r3: a whole round
-        # without a live number). When this run can't measure, point
-        # at the committed chip artifact so the JSON self-documents
-        # where the last verifiable number lives.
-        try:
-            with open(os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)),
-                    'BENCH_TPU.json'), encoding='utf-8') as f:
-                art = json.load(f)
-            result['telemetry_committed_artifact'] = {
-                'file': 'BENCH_TPU.json',
-                'date': art.get('date'),
-                'device': art.get('device'),
-                'telemetry_pools_per_sec_pallas':
-                    art.get('telemetry_pools_per_sec_pallas'),
-                'telemetry_pools_per_sec_xla':
-                    art.get('telemetry_pools_per_sec_xla'),
-                'telemetry_pools_per_sec_scan':
-                    art.get('telemetry_pools_per_sec_scan'),
-            }
-        except (OSError, ValueError):
-            pass
+    if telem.get('error') is not None:
+        result['telemetry_error'] = telem['error']
+    if telem.get('pools_per_sec_live') is None:
+        result.update(artifact_citation())
     print(json.dumps(result))
 
 

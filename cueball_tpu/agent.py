@@ -410,8 +410,14 @@ class CueBallAgent(EventEmitter):
             elif not keep_alive:
                 handle.close()
             else:
-                self.log.debug('health check on %s ok (%d)', host,
-                               resp_obj.status)
+                # Success stays below INFO (reference changelog #105:
+                # per-interval success at INFO was pure noise) and
+                # names the pool's domain + latency/path/status
+                # (reference changelog #109).
+                self.log.debug(
+                    'health check on pool "%s" ok (status %d, '
+                    'latency %.0fms, path %s)', host,
+                    resp_obj.status, latency, self.cba_ping)
                 handle.release()
         except Exception as e:
             self.log.warning('health check on %s failed: %r', host, e)

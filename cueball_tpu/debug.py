@@ -41,8 +41,20 @@ def _fsm_line(tag: str, fsm) -> str:
     except Exception:
         state = '?'
     hist = []
+    timed = getattr(fsm, 'get_history_timed', None)
     get_history = getattr(fsm, 'get_history', None)
-    if get_history is not None:
+    if timed is not None:
+        try:
+            # Dwell annotations (reference changelog #119): how long
+            # each recorded state actually lasted.
+            entries = timed()
+            for i, (name, at) in enumerate(entries):
+                if i + 1 < len(entries):
+                    name += '(%dms)' % round(entries[i + 1][1] - at)
+                hist.append(name)
+        except Exception:
+            pass
+    elif get_history is not None:
         try:
             hist = get_history()
         except Exception:

@@ -33,6 +33,7 @@ Semantics replicated:
 from __future__ import annotations
 
 import asyncio
+import time
 import typing
 
 from .events import EventEmitter, _native
@@ -249,6 +250,7 @@ class FSM(EventEmitter):
         self._fsm_state: str | None = None
         self._fsm_state_handle: StateHandle | None = None
         self._fsm_history: list[str] = []
+        self._fsm_history_at: list[float] = []
         self._fsm_all_state_events: list[str] = []
         self._fsm_in_transition = False
         self._fsm_pending: list[str] = []
@@ -278,6 +280,12 @@ class FSM(EventEmitter):
 
     def get_history(self) -> list[str]:
         return list(self._fsm_history)
+
+    def get_history_timed(self) -> list[tuple[str, float]]:
+        """History with entry timestamps (epoch ms) — the debugging
+        aid reference changelog #119 added via mooremachine (how long
+        did each state, e.g. a claim's 'waiting', actually take)."""
+        return list(zip(self._fsm_history, self._fsm_history_at))
 
     # -- all-state events ------------------------------------------------
 
@@ -360,8 +368,10 @@ class FSM(EventEmitter):
 
         self._fsm_state = state
         self._fsm_history.append(state)
+        self._fsm_history_at.append(time.time() * 1000.0)
         if len(self._fsm_history) > self.HISTORY_LENGTH:
             del self._fsm_history[0]
+            del self._fsm_history_at[0]
 
         new_handle = StateHandle(self, state)
         self._fsm_state_handle = new_handle

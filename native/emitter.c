@@ -27,6 +27,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 #include <structmember.h>
+#include <time.h>
 
 /* ------------------------------------------------------------------ */
 /* Once wrapper                                                        */
@@ -1204,6 +1205,7 @@ static PyObject *fsm_tracers;          /* list, shared with fsm.py */
 static PyObject *fsm_get_running_loop; /* asyncio.get_running_loop */
 
 static PyObject *str_fsm_history;      /* "_fsm_history" */
+static PyObject *str_fsm_history_at;   /* "_fsm_history_at" */
 static PyObject *str_dispose_all_name; /* "_dispose_all" */
 static PyObject *str_entry_cache;      /* "_fsm_entry_cache" */
 static PyObject *str_history_length;   /* "HISTORY_LENGTH" */
@@ -1653,6 +1655,42 @@ fsm_run_transition_impl(PyObject *fsm, PyObject *state)
             }
         }
         Py_DECREF(hist);
+
+        /* Parallel entry-timestamp ring (epoch ms), the mooremachine
+         * timestamps debugging aid (reference changelog #119); kept
+         * in lockstep with _fsm_history so get_history_timed() can
+         * zip them. */
+        int aterr;
+        PyObject *atstrong;
+        PyObject *ats_b = fsm_field_borrow(fsm, str_fsm_history_at,
+                                           &aterr, &atstrong);
+        PyObject *ats = ats_b ? Py_NewRef(ats_b) : NULL;
+        Py_XDECREF(atstrong);
+        if (ats == NULL || !PyList_Check(ats)) {
+            Py_XDECREF(ats);
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_TypeError,
+                                "_fsm_history_at must be a list");
+            goto fail;
+        }
+        struct timespec ts;
+        clock_gettime(CLOCK_REALTIME, &ts);
+        PyObject *ms = PyFloat_FromDouble(
+            (double)ts.tv_sec * 1000.0 + (double)ts.tv_nsec / 1e6);
+        if (ms == NULL || PyList_Append(ats, ms) < 0) {
+            Py_XDECREF(ms);
+            Py_DECREF(ats);
+            goto fail;
+        }
+        Py_DECREF(ms);
+        n = PyList_GET_SIZE(ats);
+        if (n > maxlen) {
+            if (PyList_SetSlice(ats, 0, n - maxlen, NULL) < 0) {
+                Py_DECREF(ats);
+                goto fail;
+            }
+        }
+        Py_DECREF(ats);
     }
 
     /* New handle becomes current before the entry function runs. */
@@ -1962,6 +2000,8 @@ PyInit__cueball_native(void)
             PyUnicode_InternFromString("_fsm_state")) == NULL ||
         (str_fsm_history =
             PyUnicode_InternFromString("_fsm_history")) == NULL ||
+        (str_fsm_history_at =
+            PyUnicode_InternFromString("_fsm_history_at")) == NULL ||
         (str_dispose_all_name =
             PyUnicode_InternFromString("_dispose_all")) == NULL ||
         (str_entry_cache =

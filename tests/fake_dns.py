@@ -142,6 +142,20 @@ class FakeDnsClient:
                 answers.append(_rr(domain, 'A', 3600, '1.2.3.8'))
             else:
                 err = DnsError('NXDOMAIN', domain)
+        elif tld == 'srvref':
+            # SRV queries REFUSED outright (an authoritative server
+            # refusing recursion for records outside its authority,
+            # reference changelog #115): the resolver must treat it
+            # as name-not-known — no retry ladder, straight fall
+            # through to plain-name A/AAAA on the base domain.
+            if qtype == 'SRV':
+                err = DnsError('REFUSED', domain)
+            elif parts[1] == 'srv' and qtype == 'A':
+                answers.append(_rr(domain, 'A', 3600, '1.2.3.21'))
+            elif parts[1] == 'srv' and qtype == 'AAAA':
+                pass  # NODATA
+            else:
+                err = DnsError('NXDOMAIN', domain)
         elif tld == 'addl':
             # SRV answers carrying A+AAAA additionals for their target:
             # the resolver must use them and skip the address lookups

@@ -1,0 +1,67 @@
+"""The chip-artifact staleness guard (bench.py).
+
+The driver's bench run falls back to citing the committed
+BENCH_TPU.json when the chip tunnel is down; these tests lock the rule
+that the citation carries the artifact's measured-path code hash and
+is REFUSED (explicit 'stale' marker, no numbers) whenever that hash no
+longer matches the working tree."""
+
+import json
+import os
+
+import bench
+
+
+def test_code_hash_is_stable_and_tracks_measured_files():
+    h1 = bench.telemetry_code_hash()
+    h2 = bench.telemetry_code_hash()
+    assert h1 == h2
+    assert len(h1) == 16
+    int(h1, 16)   # hex
+
+
+def test_citation_cites_only_hash_matched_artifacts(tmp_path):
+    # No artifact: nothing to cite, nothing to refuse.
+    assert bench.artifact_citation(str(tmp_path)) == {}
+
+    # Hash-matched artifact: cited, with the hash in the citation.
+    head = bench.telemetry_code_hash()
+    art = {'code_hash': head, 'date': 'D', 'device': 'TPU test0',
+           'telemetry_pools_per_sec_live': 123.0,
+           'telemetry_pools_per_sec_xla': 100.0,
+           'telemetry_pools_per_sec_pallas': 120.0,
+           'telemetry_pools_per_sec_scan': 999.0}
+    (tmp_path / 'BENCH_TPU.json').write_text(json.dumps(art))
+    out = bench.artifact_citation(str(tmp_path))
+    cited = out['telemetry_committed_artifact']
+    assert cited['code_hash'] == head
+    assert cited['telemetry_pools_per_sec_live'] == 123.0
+    assert 'telemetry_artifact_stale' not in out
+
+    # Stale artifact (measured-path code changed since capture):
+    # refused with both hashes on record and NO numbers.
+    art['code_hash'] = '0' * 16
+    (tmp_path / 'BENCH_TPU.json').write_text(json.dumps(art))
+    out = bench.artifact_citation(str(tmp_path))
+    assert 'telemetry_committed_artifact' not in out
+    stale = out['telemetry_artifact_stale']
+    assert stale['artifact_code_hash'] == '0' * 16
+    assert stale['head_code_hash'] == head
+    assert 'telemetry_pools_per_sec_live' not in stale
+
+
+def test_committed_artifact_if_present_is_not_stale():
+    """If the repo ships a BENCH_TPU.json, its recorded hash must
+    match the current measured-path code — otherwise the capture was
+    forgotten after a kernel/laws change and the citation path would
+    refuse it at bench time."""
+    root = os.path.dirname(os.path.abspath(bench.__file__))
+    path = os.path.join(root, 'BENCH_TPU.json')
+    if not os.path.exists(path):
+        return
+    with open(path, encoding='utf-8') as f:
+        art = json.load(f)
+    if 'code_hash' not in art:
+        return   # pre-guard artifact; superseded by the next capture
+    assert art['code_hash'] == bench.telemetry_code_hash(), (
+        'BENCH_TPU.json is stale: re-run tools/chip_bench.py')

@@ -1,0 +1,287 @@
+"""Regression locks ported from the reference changelog
+(/root/reference/CHANGES.adoc), complementing the issue-numbered tests
+already embedded in the per-component suites (#30 #47 #92 #96 #108
+#111 #118 #132 #144 #148 and the feature suites the audit table in
+docs/changelog-audit.md links). Each test here names the changelog
+entry it locks.
+"""
+
+import asyncio
+import gc
+import time
+
+import pytest
+
+import cueball_tpu as cb
+from cueball_tpu.dns_client import DnsError
+from cueball_tpu.events import EventEmitter
+from cueball_tpu.fsm import FSM, get_loop
+from cueball_tpu.pool import ConnectionPool
+from cueball_tpu.resolver import ResolverFSM
+
+from conftest import run_async, settle, wait_for_state
+from fake_dns import Cfg
+from test_cset import make_cset
+from test_dns import history, make_res
+from test_pool import Ctx, DummyInner, claim, make_pool
+
+
+# -- #151 (v2.10.0): error retries must reuse a previously-seen TTL ----
+
+def test_cueball_151_error_retry_uses_remembered_ttl():
+    """Once a lookup has returned a real TTL, an exhausted retry
+    ladder schedules the next attempt at that TTL — NOT the 60 s
+    bootstrap default (dns_resolver.py state_a_error; reference
+    changelog #151)."""
+    async def t():
+        Cfg.flaky_fails = {'A': 99}
+        res, client = make_res('srv.flaky')
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+
+        fsm = res.r_fsm
+        # The successful AAAA (ttl 3600) must have been remembered...
+        assert fsm.r_last_ttl == 3600
+        # ...and the exhausted A ladder scheduled with it: far beyond
+        # the 60 s default the resolver booted with.
+        assert fsm.r_next_v4 is not None
+        assert fsm.r_next_v4 - time.time() > 1800
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+# -- #150 (v2.10.0): errors chain back to their original cause ---------
+
+def test_cueball_150_resolver_error_chains_dns_cause():
+    """The resolver's recorded failure chains (__cause__) back to the
+    concrete DnsError, the VError-chaining analogue (errors.py has the
+    class-level locks in test_errors; this locks a live chain)."""
+    async def t():
+        Cfg.flaky_fails = {'A': 99}
+        res, client = make_res('srv.flaky')
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+        err = res.r_fsm.r_last_error
+        assert err is not None and 'IPv4' in str(err)
+        assert isinstance(err.__cause__, DnsError)
+        assert err.__cause__.code == 'SERVFAIL'
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+# -- #115 (v2.5.0): REFUSED handled as name-not-known ------------------
+
+def test_cueball_115_srv_refused_falls_through_to_plain_name():
+    """An SRV REFUSED (authoritative server refusing records outside
+    its authority, as modern binders produce) must behave like
+    name-not-known: no retry ladder, immediate fall-through to
+    plain-name A/AAAA (dns_resolver.py state_srv_try on_error;
+    reference changelog #115, lib/resolver.js:646-655)."""
+    async def t():
+        res, client = make_res('srv.srvref')
+        backends = []
+        res.on('added', lambda k, b: backends.append(b))
+        res.start()
+        await wait_for_state(res, 'running', timeout=10)
+
+        h = history(client)
+        # Exactly ONE SRV attempt: REFUSED is non-retryable.
+        assert h.count('_foo._tcp.srv.srvref/SRV') == 1
+        assert [b['address'] for b in backends] == ['1.2.3.21']
+        res.stop()
+        await wait_for_state(res, 'stopped')
+    run_async(t())
+
+
+# -- #123 (v2.3.0): ConnectionSet memory leak during failure -----------
+
+def test_cueball_123_cset_failure_churn_does_not_leak():
+    """Repeated failed->recovered cycles must not accumulate objects
+    (the reference leaked per-failure state in the cset; changelog
+    #123). Modeled on test_gc's pool churn soak."""
+    async def t():
+        ctx = Ctx()
+        cset, inner, resolver = make_cset(
+            ctx, target=1, maximum=2,
+            recovery={'default': {'timeout': 100, 'retries': 0,
+                                  'delay': 0}})
+        cset.on('added', lambda key, conn, hdl: None)
+        cset.on('removed', lambda key, conn, hdl: hdl.release())
+        inner.emit('added', 'b1', {})
+        await settle()
+
+        async def fail_and_recover():
+            # Kill every live connection -> 'failed'; then let the
+            # monitor's fresh attempt succeed -> 'running'. Close (not
+            # 'error') so the advertised logical connection drains via
+            # its handle rather than rethrowing at the claimer.
+            for c in list(ctx.connections):
+                if c.connected and not c.dead:
+                    c.destroy()
+                    c.emit('close')
+            for _ in range(200):
+                if cset.is_in_state('failed'):
+                    break
+                await asyncio.sleep(0.01)
+            for _ in range(200):
+                fresh = [c for c in ctx.connections
+                         if not c.connected and not c.dead]
+                if fresh:
+                    fresh[-1].connect()
+                    break
+                await asyncio.sleep(0.01)
+            await wait_for_state(cset, 'running', timeout=5)
+            # Retire fixture bookkeeping so the fixture list itself
+            # is not what "grows".
+            ctx.connections[:] = [c for c in ctx.connections
+                                  if not c.dead]
+
+        for _ in range(3):          # warm-up
+            await fail_and_recover()
+        gc.collect()
+        baseline = len(gc.get_objects())
+        cycles = 10
+        for _ in range(cycles):
+            await fail_and_recover()
+        gc.collect()
+        grown = len(gc.get_objects()) - baseline
+        assert grown < 120 * cycles, \
+            'cset failure churn grew by %d objects' % grown
+
+        cset.stop()
+        resolver.stop()
+        await wait_for_state(cset, 'stopped')
+    run_async(t())
+
+
+# -- #61 (v1.3.1): None for optional settings == unset -----------------
+
+def test_cueball_61_none_optional_settings_treated_as_unset():
+    """Optional ctor options explicitly passed as None must behave as
+    if omitted (the reference handles null like undefined; changelog
+    #61) — on the pool and the cset alike."""
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(
+            ctx, spares=1, maximum=2,
+            maxChurnRate=None, decoherenceInterval=None,
+            targetClaimDelay=None, checkTimeout=None, checker=None,
+            service=None, log=None)
+        inner.emit('added', 'b1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await wait_for_state(pool, 'running', timeout=5)
+        assert pool.p_codel is None          # CoDel off, not crashed
+        fut, _ = claim(pool, {'timeout': 1000})
+        hdl, _conn = await fut
+        hdl.release()
+        pool.stop()
+
+        cset, inner2, resolver2 = make_cset(
+            ctx, target=1, maximum=2,
+            decoherenceInterval=None, connectionHandlesError=None,
+            log=None)
+        cset.on('added', lambda key, conn, hdl: None)
+        cset.on('removed', lambda key, conn, hdl: hdl.release())
+        inner2.emit('added', 'c1', {})
+        await settle()
+        for c in list(ctx.connections):
+            if not c.connected and not c.dead:
+                c.connect()
+        await wait_for_state(cset, 'running', timeout=5)
+        cset.stop()
+        resolver2.stop()
+        await settle(30)
+    run_async(t())
+
+
+# -- #119 (v2.2.9): FSM history carries timestamps ---------------------
+
+class _TwoState(FSM):
+    def state_a(self, S):
+        S.validTransitions(['b'])
+
+    def state_b(self, S):
+        S.validTransitions(['a'])
+
+
+def test_cueball_119_fsm_history_is_timestamped():
+    """get_history_timed() pairs every recorded state with its entry
+    time (epoch ms), the mooremachine-timestamps debugging aid of
+    changelog #119 (how long did a claim actually wait); the SIGUSR2
+    debug dump renders the dwell times."""
+    async def t():
+        m = _TwoState('a')
+        t0 = time.time() * 1000.0
+        m._goto_state('b')
+        m._goto_state('a')
+        timed = m.get_history_timed()
+        assert [s for s, _at in timed] == m.get_history()
+        ats = [at for _s, at in timed]
+        assert ats == sorted(ats)
+        assert all(abs(at - t0) < 5000 for at in ats)
+
+        from cueball_tpu.debug import _fsm_line
+        line = _fsm_line('two', m)
+        assert 'ms)' in line     # dwell annotation rendered
+    run_async(t())
+
+
+# -- v2.1.0 / v2.2.0 API relaxations -----------------------------------
+
+class _BareConnection(EventEmitter):
+    """A Connection implementing ONLY the required surface: 'connect'
+    emission + destroy(). No ref()/unref()/setUnwanted()/localPort
+    (optional since reference v2.1.0)."""
+
+    def __init__(self, backend):
+        super().__init__()
+        self.backend = backend
+        get_loop().call_soon(lambda: self.emit('connect'))
+
+    def destroy(self):
+        pass
+
+
+def test_v2_1_0_ref_unref_are_optional():
+    async def t():
+        inner = DummyInner()
+        resolver = ResolverFSM(inner, {})
+        resolver.start()
+        pool = ConnectionPool({
+            'domain': 'bare', 'resolver': resolver,
+            'constructor': _BareConnection,
+            'spares': 1, 'maximum': 2,
+            'recovery': {'default': {'timeout': 1000, 'retries': 1,
+                                     'delay': 10}}})
+        inner.emit('added', 'b1', {})
+        await wait_for_state(pool, 'running', timeout=5)
+        fut, _ = claim(pool, {'timeout': 1000})
+        hdl, conn = await fut
+        assert isinstance(conn, _BareConnection)
+        hdl.release()
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
+def test_v2_2_0_dns_resolver_exported_at_package_root():
+    assert cb.DNSResolver is not None
+    # And the camelCase-free Python spelling resolves to the same
+    # class the docs name.
+    from cueball_tpu.dns_resolver import DNSResolver
+    assert cb.DNSResolver is DNSResolver
+
+
+# -- pytest plumbing ----------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _reset_fake_dns():
+    yield
+    Cfg.flaky_fails = {}
+    Cfg.use_a2 = False
+    Cfg.srv_refuse = False
+    Cfg.srv_ttl = 3600

@@ -5,6 +5,7 @@ history dumps, and contextual child loggers."""
 import asyncio
 import logging
 import os
+import re
 import signal
 
 import cueball_tpu as cb
@@ -56,8 +57,10 @@ def test_dump_covers_pool_slots_and_history():
         assert '(pool)' in report and 'state=running' in report
         # Slot + socket-manager lines with their history rings.
         assert 'slot ' in report and 'smgr' in report
-        assert 'starting->running' in report      # pool history
-        assert 'connecting->connected' in report  # smgr history
+        # History entries carry dwell annotations (changelog #119),
+        # e.g. 'starting(3ms)->running'.
+        assert re.search(r'starting\(\d+ms\)->running', report)
+        assert re.search(r'connecting\(\d+ms\)->connected', report)
         pool.stop()
     run_async(t())
 
