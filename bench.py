@@ -405,8 +405,9 @@ def bench_telemetry_stages(emit, pools=TELEM_POOLS):
         jax.block_until_ready(state)
         return n * iters / (time.perf_counter() - t0)
 
-    emit({'stage': 'step_small', 'small_pools': TELEM_SMALL,
-          'small_pools_per_sec': live_rate(TELEM_SMALL, 100)})
+    small = min(TELEM_SMALL, pools)   # honor CI shape overrides
+    emit({'stage': 'step_small', 'small_pools': small,
+          'small_pools_per_sec': live_rate(small, 100)})
     emit({'stage': 'step_live', 'pools': pools,
           'pools_per_sec_live': live_rate(pools, 50)})
 
@@ -491,7 +492,13 @@ def _telemetry_child_main(progress_path: str) -> None:
         os.sched_setaffinity(0, range(os.cpu_count() or 1))
     except (AttributeError, OSError):
         pass
-    import jax
+    try:
+        import jax
+    except ImportError:
+        # No jax on this host: clean "unmeasured" (empty stage set,
+        # exit 0), not a broken-bench error.
+        print(json.dumps({}))
+        return
     # The container sitecustomize force-registers the TPU backend,
     # overriding JAX_PLATFORMS=cpu; honor an explicit CPU request
     # (CI exercise of the staged path) via jax.config instead.
@@ -608,13 +615,19 @@ def artifact_citation(root: str | None = None) -> dict:
         return {}
     head = telemetry_code_hash()
     if art.get('code_hash') != head:
+        if art.get('code_hash') is None:
+            note = ('refusing to cite: the artifact predates the '
+                    'code-hash guard (no hash recorded); re-capture '
+                    'with tools/chip_bench.py')
+        else:
+            note = ('refusing to cite: the artifact was captured '
+                    'from different measured-path code than the '
+                    'working tree')
         return {'telemetry_artifact_stale': {
             'file': 'BENCH_TPU.json',
             'artifact_code_hash': art.get('code_hash'),
             'head_code_hash': head,
-            'note': ('refusing to cite: the artifact was captured '
-                     'from different measured-path code than the '
-                     'working tree'),
+            'note': note,
         }}
     return {'telemetry_committed_artifact': {
         'file': 'BENCH_TPU.json',

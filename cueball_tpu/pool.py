@@ -212,6 +212,10 @@ class ConnectionPool(FSM):
         self.p_pace_shaving = False
         self.p_pace_above_since = 0.0
         self.p_pace_below_since = 0.0
+        # Mean-tracking accumulator for the current overload episode
+        # (see _pace_comp): sum of (sojourn - target) over every
+        # resolved waiter.
+        self.p_pace_sum_err = 0.0
 
         self.p_last_error = None
         self.p_counters: dict[str, int] = {}
@@ -336,13 +340,45 @@ class ConnectionPool(FSM):
         self.p_codel_pacer = get_loop().call_later(
             CODEL_PACE / 1000.0, self._codel_pace)
 
-    def _pace_reset(self) -> None:
-        """Forget the shave-mode episode clocks so the next overload
-        episode gets full CoDel burst tolerance (the analogue of
-        ControlledDelay.empty() resetting cd_first_above_time)."""
+    def _pace_clocks_reset(self) -> None:
+        """Forget the shave-mode clocks so the next overload burst
+        gets full CoDel burst tolerance (the analogue of
+        ControlledDelay.empty() resetting cd_first_above_time). The
+        mean-tracking accumulators survive: transient service stalls
+        and hysteresis exits happen mid-episode, and wiping the
+        deficit there would re-introduce the ramp-up undershoot."""
         self.p_pace_shaving = False
         self.p_pace_above_since = 0.0
         self.p_pace_below_since = 0.0
+
+    def _pace_reset(self) -> None:
+        """Episode over (claim queue fully drained): clocks AND the
+        mean-tracking accumulator start fresh."""
+        self._pace_clocks_reset()
+        self.p_pace_sum_err = 0.0
+
+    def _pace_account(self, sojourn_err: float) -> None:
+        """One resolved waiter's (sojourn - target) enters the
+        episode's running deficit."""
+        self.p_pace_sum_err += sojourn_err
+
+    def _pace_comp(self) -> float:
+        """Mean-tracking compensation (ms) added to the shed
+        threshold. An overload episode's ramp-up claims structurally
+        resolve BELOW target (they can't have waited longer than the
+        episode is old), so shedding at exactly `target` leaves the
+        episode's average sojourn under the target — ~-240 ms at a
+        5000 ms target under the reference's own load protocol
+        (test/codel.test.js:245-297). Shedding at
+        `target + deficit/queue_len` makes each shed repay an equal
+        share of the accumulated deficit; the deficit-per-queued-claim
+        ratio is invariant as the queue drains, so the episode's mean
+        lands on the target. Capped at `target` (no shed waits past
+        2x target; the getMaxIdle bound still applies far above)."""
+        if self.p_pace_sum_err >= 0.0 or len(self.p_waiters) == 0:
+            return 0.0
+        return min(-self.p_pace_sum_err / len(self.p_waiters),
+                   self.p_codel.cd_targdelay)
 
     def _codel_pace(self) -> None:
         self.p_codel_pacer = None
@@ -360,17 +396,20 @@ class ConnectionPool(FSM):
             # Service stalled: stop pacing entirely (the reference
             # behaviour — shed at dequeue or at the getMaxIdle bound —
             # takes over). The next dequeue or queued claim re-arms.
-            self._pace_reset()
+            # Clocks only: the episode (standing queue) continues.
+            self._pace_clocks_reset()
             return
         target = self.p_codel.cd_targdelay
         interval = mod_codel.CODEL_INTERVAL
+        comp = self._pace_comp()
         head_over = False
         while len(self.p_waiters) > 0:
             hdl = self.p_waiters.peek()
             if not hdl.is_in_state('waiting'):
                 self.p_waiters.shift()
                 continue
-            if now - hdl.ch_started <= target:
+            soj = now - hdl.ch_started
+            if soj <= target:
                 break
             head_over = True
             if self.p_pace_above_since == 0:
@@ -379,8 +418,11 @@ class ConnectionPool(FSM):
                     now - self.p_pace_above_since < interval:
                 break
             self.p_pace_shaving = True
+            if soj <= target + comp:
+                break
             self.p_waiters.shift()
             self._incr_counter('codel-paced-drop')
+            self._pace_account(soj - target)
             hdl.timeout()
         if head_over:
             self.p_pace_below_since = 0
@@ -388,7 +430,7 @@ class ConnectionPool(FSM):
             if self.p_pace_below_since == 0:
                 self.p_pace_below_since = now
             elif now - self.p_pace_below_since >= interval:
-                self._pace_reset()
+                self._pace_clocks_reset()
         else:
             self.p_pace_above_since = 0
         if len(self.p_waiters) == 0:
@@ -780,12 +822,25 @@ class ConnectionPool(FSM):
                     return
 
                 self.p_last_dequeue = mod_utils.current_millis()
+                # Both shed sites share the pacer's mean-tracking
+                # threshold: the start is shifted forward by the
+                # compensation so the scalar CoDel only sees a claim
+                # as over-target once its TRUE sojourn exceeds
+                # target + comp (see _pace_comp).
+                comp = self._pace_comp() if self.p_codel is not None \
+                    else 0.0
                 while len(self.p_waiters) > 0:
                     hdl = self.p_waiters.shift()
                     drop = self.p_codel is not None and \
-                        self.p_codel.overloaded(hdl.ch_started)
+                        self.p_codel.overloaded(hdl.ch_started + comp)
                     if not hdl.is_in_state('waiting'):
                         continue
+                    if self.p_codel is not None:
+                        # Every resolved waiter (served or dropped)
+                        # feeds the pacer's mean-tracking deficit.
+                        self._pace_account(
+                            self.p_last_dequeue - hdl.ch_started -
+                            self.p_codel.cd_targdelay)
                     if drop:
                         hdl.timeout()
                         continue

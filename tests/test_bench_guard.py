@@ -48,6 +48,15 @@ def test_citation_cites_only_hash_matched_artifacts(tmp_path):
     assert stale['artifact_code_hash'] == '0' * 16
     assert stale['head_code_hash'] == head
     assert 'telemetry_pools_per_sec_live' not in stale
+    assert 'different measured-path code' in stale['note']
+
+    # Pre-guard artifact (no hash at all): refused too, but the note
+    # must say the provenance is unknown, not claim a code mismatch.
+    del art['code_hash']
+    (tmp_path / 'BENCH_TPU.json').write_text(json.dumps(art))
+    stale = bench.artifact_citation(
+        str(tmp_path))['telemetry_artifact_stale']
+    assert 'predates the code-hash guard' in stale['note']
 
 
 def test_committed_artifact_if_present_is_not_stale():

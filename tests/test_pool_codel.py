@@ -6,6 +6,8 @@ failure modes."""
 
 import asyncio
 
+import pytest
+
 from cueball_tpu import errors as mod_errors
 from cueball_tpu.utils import current_millis
 
@@ -85,15 +87,20 @@ def _run_target(target):
         assert pool.get_stats()['counters'].get('codel-paced-drop', 0) > 0
         pool.stop()
         await wait_for_state(pool, 'stopped')
-    run_async(t(), timeout=30)
+    # The 5000 ms target needs ~13 s (5 s load + sheds pace the drain).
+    run_async(t(), timeout=60)
 
 
-def test_codel_tracks_300ms_target():
-    _run_target(300)
-
-
-def test_codel_tracks_1000ms_target():
-    _run_target(1000)
+# The FULL reference envelope: all seven targets asserted in-suite,
+# exactly as reference test/codel.test.js:285-297 does. The
+# mean-tracking pacer compensation (pool._pace_comp) is what holds
+# the long targets: without it the 5000 ms target undershoots by
+# ~-240 ms (ramp-up claims resolve below target structurally) and
+# fails the reference's own +/-175 ms assertion.
+@pytest.mark.parametrize('target',
+                         [300, 500, 1000, 1500, 2000, 2500, 5000])
+def test_codel_tracks_target(target):
+    _run_target(target)
 
 
 def test_timeout_option_forbidden_with_codel():

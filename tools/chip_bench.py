@@ -83,7 +83,9 @@ def main() -> int:
     # BENCH_TPU.json would read as measured-and-absent.
     needed = ('pools_per_sec_live', 'pools_per_sec_xla',
               'pools_per_sec_scan', 'dispatch_floor_us')
-    if any(telem.get(k) is None for k in needed):
+    tick_done = any(k.startswith('tick_us_') for k in telem)
+    if any(telem.get(k) is None for k in needed) or not tick_done \
+            or telem.get('error') is not None:
         log_line(args.log,
                  'capture: INCOMPLETE after %gs (stages: %s; error: %s)'
                  % (args.timeout, ','.join(filter(None, stages)),
