@@ -9,6 +9,9 @@ the parts cueball uses:
 - response parsing with name decompression, answers/authority/additionals
   sections, and the record types the resolver consumes
   (A, AAAA, SRV, SOA, CNAME/DNAME recognition, OPT skipping)
+- EDNS(0): queries advertise a 1400 B UDP payload via an OPT
+  pseudo-RR (RFC 6891), so fleet-sized SRV answer sets arrive in one
+  datagram instead of eating a TC->TCP retry per refresh
 - UDP transport with TCP fallback when the TC (truncation) bit is set
 - multi-resolver fan-out with per-resolver error collection; when all
   resolvers fail the caller receives a MultiError whose parts carry the
@@ -98,12 +101,27 @@ def encode_name(name: str) -> bytes:
     return out + b'\x00'
 
 
-def build_query(qid: int, domain: str, qtype: str) -> bytes:
+# EDNS(0) advertised UDP payload size (RFC 6891). The plain-DNS 512 B
+# ceiling truncates the SRV answer set of any real fleet (~18 records)
+# and costs a TCP retry per refresh; 1400 keeps the datagram under
+# common path MTUs while fitting ~60 SRV records.
+EDNS_UDP_SIZE = 1400
+
+
+def build_query(qid: int, domain: str, qtype: str,
+                edns_size: int | None = EDNS_UDP_SIZE) -> bytes:
     flags = 0x0100  # RD
-    header = struct.pack('>HHHHHH', qid, flags, 1, 0, 0, 0)
+    arcount = 0 if edns_size is None else 1
+    header = struct.pack('>HHHHHH', qid, flags, 1, 0, 0, arcount)
     question = encode_name(domain) + struct.pack(
         '>HH', TYPE_CODES[qtype], CLASS_IN)
-    return header + question
+    if edns_size is None:
+        return header + question
+    # OPT pseudo-RR (RFC 6891 6.1.2): root name, TYPE=OPT, CLASS
+    # carries the advertised UDP payload size, TTL carries extended
+    # rcode/version/flags (all zero: EDNS version 0, no DO), no rdata.
+    opt = b'\x00' + struct.pack('>HHIH', TYPE_OPT, edns_size, 0, 0)
+    return header + question + opt
 
 
 def _decode_name(data: bytes, off: int) -> tuple[str, int]:
@@ -291,6 +309,16 @@ class DnsClient:
         try:
             data = await query_udp(host, port, payload, timeout_s)
             msg = parse_response(data)
+            if msg.rcode in ('FORMERR', 'NOTIMP'):
+                # Legacy server/middlebox rejecting the OPT record:
+                # retry once as a plain RFC 1035 query
+                # (RFC 6891 6.2.2). A genuine FORMERR/NOTIMP just
+                # comes back again and propagates below.
+                qid = random.randrange(65536)
+                payload = build_query(qid, domain, qtype,
+                                      edns_size=None)
+                data = await query_udp(host, port, payload, timeout_s)
+                msg = parse_response(data)
             if msg.tc:
                 data = await query_tcp(host, port, payload, timeout_s)
                 msg = parse_response(data)

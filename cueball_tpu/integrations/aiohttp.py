@@ -157,6 +157,7 @@ class CueballConnector(aiohttp.BaseConnector):
         self._cb_pools: dict[tuple, ConnectionPool] = {}
         self._cb_resolvers: dict[tuple, object] = {}
         self._cb_claims: dict[ResponseHandler, object] = {}
+        self._cb_closing = False   # set synchronously by close()
 
     # -- pool plumbing ----------------------------------------------------
 
@@ -216,6 +217,12 @@ class CueballConnector(aiohttp.BaseConnector):
     def _make_pool(self, key: tuple, host: str, port: int,
                    resolver=None, ssl_ctx=None,
                    server_hostname=None) -> ConnectionPool:
+        # The one chokepoint every pool-creation path funnels through
+        # (connect() and the public create_pool()): after close() has
+        # begun, a fresh pool+resolver would be stored into the
+        # already-torn-down dicts and never stopped.
+        if self._closed or self._cb_closing:
+            raise RuntimeError('CueballConnector is closed')
         opts = self._cb_options
         is_ssl = key[2]
         if resolver is None:
@@ -257,7 +264,12 @@ class CueballConnector(aiohttp.BaseConnector):
         """Claim a pooled connection and hand aiohttp its protocol
         (replaces BaseConnector.connect: cueball is the sole pooler,
         the base keep-alive cache is never used)."""
-        if self._closed:
+        # _cb_closing is set synchronously at the top of close():
+        # aiohttp's own _closed flips only at the END of the async
+        # teardown, and a connect() in that window would re-create a
+        # pool+resolver in the just-emptied dict that nothing would
+        # ever stop (the httpx twin sets its flag synchronously too).
+        if self._closed or self._cb_closing:
             raise aiohttp.ClientConnectionError('Connector is closed.')
         if req.proxy:
             raise aiohttp.ClientConnectionError(
@@ -282,7 +294,18 @@ class CueballConnector(aiohttp.BaseConnector):
             for trace in traces:
                 await trace.send_connection_create_start()
         try:
-            handle, sock = await pool.claim(claim_opts)
+            if connect_timeout is not None and pool.codel_enabled():
+                # CoDel pools forbid an explicit claim timeout, but
+                # the caller's connect timeout still binds: race the
+                # whole claim from outside (same contract as the
+                # httpx transport; docs/api.md integrations).
+                try:
+                    handle, sock = await asyncio.wait_for(
+                        pool.claim(claim_opts), connect_timeout)
+                except asyncio.TimeoutError as e:
+                    raise mod_errors.ClaimTimeoutError(pool) from e
+            else:
+                handle, sock = await pool.claim(claim_opts)
         except mod_errors.ClaimTimeoutError as e:
             raise aiohttp.ConnectionTimeoutError(str(e)) from e
         except (mod_errors.NoBackendsError,
@@ -323,7 +346,9 @@ class CueballConnector(aiohttp.BaseConnector):
 
     def close(self, *, abort_ssl: bool = False):
         """Stop every pool (and its resolver), reclaiming outstanding
-        claims, then run the base teardown."""
+        claims, then run the base teardown. New connect()s are
+        rejected from this point on, not from the end of the task."""
+        self._cb_closing = True
         return self._loop.create_task(self._cb_close(abort_ssl))
 
     async def _cb_close(self, abort_ssl: bool):

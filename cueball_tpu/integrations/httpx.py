@@ -201,12 +201,23 @@ class CueballTransport(httpx.AsyncBaseTransport):
                 self._lazy_bare_hosts.add((scheme, host))
         claim_opts = {}
         # A CoDel pool derives its own claim deadline and (like the
-        # reference, lib/pool.js:874-885) forbids an explicit one;
-        # httpx's default 5s pool timeout must not break such pools.
+        # reference, lib/pool.js:874-885) forbids an explicit one, so
+        # the pool timeout is never passed INTO the claim. It still
+        # binds, though — httpx semantics, including the client's
+        # default pool=5s: the whole claim is raced against it from
+        # OUTSIDE the pool and maps to PoolTimeout. Callers pairing a
+        # long targetClaimDelay with queue waits beyond 5s must raise
+        # or disable the client's pool timeout (docs/api.md).
         if timeout_ms is not None and not pool.codel_enabled():
             claim_opts['timeout'] = timeout_ms
         if agent.cba_err_on_empty is not None:
             claim_opts['errorOnEmpty'] = agent.cba_err_on_empty
+        if timeout_ms is not None and pool.codel_enabled():
+            try:
+                return await asyncio.wait_for(pool.claim(claim_opts),
+                                              timeout_ms / 1000.0)
+            except asyncio.TimeoutError as e:
+                raise mod_errors.ClaimTimeoutError(pool) from e
         return await pool.claim(claim_opts)
 
     # -- the transport contract -------------------------------------------

@@ -359,8 +359,23 @@ class ConnectionPool(FSM):
 
     def _pace_account(self, sojourn_err: float) -> None:
         """One resolved waiter's (sojourn - target) enters the
-        episode's running deficit."""
+        episode's running deficit.
+
+        Clamped to +/- target * (queue_len + 1): the deficit exists to
+        repay the CURRENT standing queue's worth of compensation, and
+        a genuine overload ramp never banks more than that (arrivals
+        outpace service, so the queue grows faster than the deficit).
+        Without the clamp, a long healthy-but-never-quite-empty
+        stretch (sojourns far below target, queue never draining to
+        zero) would bank an unbounded deficit and pin the shed
+        threshold at 2x target for minutes into the next real
+        overload."""
         self.p_pace_sum_err += sojourn_err
+        limit = self.p_codel.cd_targdelay * (len(self.p_waiters) + 1.0)
+        if self.p_pace_sum_err < -limit:
+            self.p_pace_sum_err = -limit
+        elif self.p_pace_sum_err > limit:
+            self.p_pace_sum_err = limit
 
     def _pace_comp(self) -> float:
         """Mean-tracking compensation (ms) added to the shed

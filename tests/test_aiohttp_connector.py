@@ -131,6 +131,64 @@ def test_pool_exhaustion_maps_to_connection_timeout():
     run_async(t())
 
 
+def test_codel_pool_still_honors_connect_timeout():
+    """With targetClaimDelay set the pool forbids an explicit claim
+    timeout, but the caller's connect timeout still binds — the claim
+    is raced from OUTSIDE the pool (twin of the httpx transport's
+    contract; ADVICE r4)."""
+    async def t():
+        async def handler(reader, writer):
+            await reader.readline()
+            while True:
+                h = await reader.readline()
+                if h in (b'\r\n', b'\n', b''):
+                    break
+            await asyncio.sleep(3.0)
+            writer.write(b'HTTP/1.1 200 OK\r\nContent-Length: 4\r\n'
+                         b'\r\nslow')
+            await writer.drain()
+            writer.close()
+        srv = await asyncio.start_server(handler, '127.0.0.1', 0)
+        port = srv.sockets[0].getsockname()[1]
+        connector = CueballConnector({'spares': 1, 'maximum': 1,
+                                      'recovery': RECOVERY,
+                                      'targetClaimDelay': 2000})
+        async with aiohttp.ClientSession(connector=connector) as s:
+            first = asyncio.ensure_future(
+                s.get('http://127.0.0.1:%d/' % port))
+            await asyncio.sleep(0.2)
+            t0 = time.monotonic()
+            with pytest.raises(aiohttp.ConnectionTimeoutError):
+                async with s.get('http://127.0.0.1:%d/' % port,
+                                 timeout=aiohttp.ClientTimeout(
+                                     total=5, connect=0.3)):
+                    pass
+            # Bounded by the caller's 0.3s, not CoDel's 2s horizon.
+            assert time.monotonic() - t0 < 1.5
+            first.cancel()
+            try:
+                await first
+            except (asyncio.CancelledError, aiohttp.ClientError):
+                pass
+        srv.close()
+    run_async(t())
+
+
+def test_create_pool_after_close_refused():
+    """The synchronous closing flag guards the public create_pool too:
+    a racing create after close() must not start a pool+resolver that
+    nothing will ever stop (ADVICE r4 leak class)."""
+    async def t():
+        connector = CueballConnector({'recovery': RECOVERY})
+        close_task = connector.close()
+        with pytest.raises(RuntimeError, match='closed'):
+            connector.create_pool('127.0.0.1', 80)
+        await close_task
+        assert connector._cb_pools == {}
+        assert connector._cb_resolvers == {}
+    run_async(t())
+
+
 def test_connection_close_response_not_reused():
     async def t():
         conns = []
@@ -309,6 +367,36 @@ def test_connect_after_close_refused():
                             RuntimeError)):
             async with session.get('http://127.0.0.1:1/'):
                 pass
+    run_async(t())
+
+
+def test_connect_racing_close_cannot_leak_a_fresh_pool():
+    """close() empties the pool dict as a task but aiohttp's _closed
+    flips only at the END of the teardown; a connect() landing in that
+    window used to sail past the check and re-create a pool+resolver
+    nothing would ever stop (ADVICE r4). The connector-owned closing
+    flag is set synchronously, so the racing connect is refused and
+    nothing is recreated."""
+    async def t():
+        server = MiniHttpServer()
+        await server.start()
+        connector = CueballConnector({'recovery': FAST_RECOVERY})
+        session = aiohttp.ClientSession(connector=connector)
+        async with session.get(
+                'http://127.0.0.1:%d/hello' % server.port) as resp:
+            assert resp.status == 200
+        assert len(connector._cb_pools) == 1
+
+        close_task = connector.close()   # synchronous flag, async work
+        with pytest.raises(aiohttp.ClientConnectionError):
+            await session.get('http://127.0.0.1:%d/hello' % server.port)
+        await close_task
+        # Nothing recreated during the window; nothing left running.
+        assert connector._cb_pools == {}
+        assert connector._cb_resolvers == {}
+        session._connector = None   # connector already closed by hand
+        await session.close()
+        server.close()
     run_async(t())
 
 

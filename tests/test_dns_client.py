@@ -19,6 +19,21 @@ def test_query_roundtrip_parse():
     rtype, rclass = struct.unpack('>HH', q[off:off + 4])
     assert rtype == dc.TYPE_SRV
     assert rclass == dc.CLASS_IN
+    # EDNS(0): one OPT pseudo-RR in the additional section advertising
+    # the 1400 B UDP payload (RFC 6891 6.1.2; CLASS carries the size).
+    assert ar == 1
+    root, off2 = dc._decode_name(q, off + 4)
+    assert root == ''
+    otype, osize, ottl, ordlen = struct.unpack(
+        '>HHIH', q[off2:off2 + 10])
+    assert otype == dc.TYPE_OPT
+    assert osize == dc.EDNS_UDP_SIZE == 1400
+    assert ottl == 0 and ordlen == 0
+    # Opt-out form (plain RFC 1035 query) keeps the old wire shape.
+    q0 = dc.build_query(0x1234, 'foo.example.com', 'SRV',
+                        edns_size=None)
+    assert struct.unpack('>HHHHHH', q0[:12])[5] == 0
+    assert len(q0) == off + 4
 
 
 def _answer_packet(qid, question, rrs):
@@ -210,6 +225,109 @@ def test_mismatched_qid_ignored():
     run_async(t())
 
 
+def test_edns_fat_srv_response_skips_tcp_round_trip():
+    """A fleet-sized SRV answer set (>512 B) arrives in ONE UDP
+    datagram because the query advertised EDNS(0) 1400 B: no TC bit,
+    no TCP retry. The scripted server behaves like a real one — it
+    truncates for plain-DNS queries and only sends the fat answer when
+    the client's OPT advertised room — and no TCP listener exists at
+    all, so any TC->TCP fallback attempt would fail the lookup."""
+    async def t():
+        loop = asyncio.get_running_loop()
+
+        class EdnsNS(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                qid = struct.unpack('>H', data[:2])[0]
+                arcount = struct.unpack('>H', data[10:12])[0]
+                name, off = dc._decode_name(data, 12)
+                question = data[12:off + 4]
+                advertised = 512
+                if arcount == 1:
+                    oname, ooff = dc._decode_name(data, off + 4)
+                    otype, osize = struct.unpack(
+                        '>HH', data[ooff:ooff + 4])
+                    if otype == dc.TYPE_OPT:
+                        advertised = osize
+                rrs = []
+                for i in range(18):     # ~960 B of SRV answers
+                    rdata = struct.pack('>HHH', 0, 10, 9000 + i) + \
+                        dc.encode_name('backend-%02d.%s' % (i, name))
+                    rrs.append((name, dc.TYPE_SRV, 60, rdata))
+                pkt = _answer_packet(qid, question, rrs)
+                assert len(pkt) > 512
+                if len(pkt) > advertised:
+                    # Plain-DNS client: truncate (QR|TC|RD|RA).
+                    pkt = struct.pack('>HHHHHH', qid, 0x8380,
+                                      1, 0, 0, 0) + question
+                self.transport.sendto(pkt, addr)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            EdnsNS, local_addr=('127.0.0.1', 0))
+        port = transport.get_extra_info('sockname')[1]
+
+        client = dc.DnsClient()
+        fut = loop.create_future()
+        client.lookup({'domain': 'fat.test', 'type': 'SRV',
+                       'timeout': 3000,
+                       'resolvers': ['127.0.0.1@%d' % port]},
+                      lambda err, msg: fut.set_result((err, msg)))
+        err, msg = await asyncio.wait_for(fut, 5)
+        assert err is None, err
+        ans = msg.get_answers()
+        assert len(ans) == 18
+        assert ans[3]['target'] == 'backend-03.fat.test'
+        assert ans[3]['port'] == 9003
+        transport.close()
+    run_async(t())
+
+
+def test_edns_formerr_falls_back_to_plain_query():
+    """A legacy server that FORMERRs any query carrying an OPT record
+    gets ONE plain RFC 1035 retry (RFC 6891 6.2.2) — lookups through
+    pre-EDNS appliances keep working."""
+    async def t():
+        loop = asyncio.get_running_loop()
+        seen = []
+
+        class LegacyNS(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+
+            def datagram_received(self, data, addr):
+                qid = struct.unpack('>H', data[:2])[0]
+                arcount = struct.unpack('>H', data[10:12])[0]
+                seen.append(arcount)
+                name, off = dc._decode_name(data, 12)
+                question = data[12:off + 4]
+                if arcount:            # OPT present: hard reject
+                    pkt = struct.pack('>HHHHHH', qid, 0x8181,
+                                      1, 0, 0, 0) + question
+                else:
+                    pkt = _answer_packet(
+                        qid, question,
+                        [(name, dc.TYPE_A, 300, bytes([10, 0, 0, 9]))])
+                self.transport.sendto(pkt, addr)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            LegacyNS, local_addr=('127.0.0.1', 0))
+        port = transport.get_extra_info('sockname')[1]
+        client = dc.DnsClient()
+        fut = loop.create_future()
+        client.lookup({'domain': 'old.test', 'type': 'A',
+                       'timeout': 3000,
+                       'resolvers': ['127.0.0.1@%d' % port]},
+                      lambda err, msg: fut.set_result((err, msg)))
+        err, msg = await asyncio.wait_for(fut, 5)
+        assert err is None, err
+        assert msg.get_answers()[0]['target'] == '10.0.0.9'
+        assert seen == [1, 0]      # EDNS first, one plain retry
+        transport.close()
+    run_async(t())
+
+
 def test_truncation_falls_back_to_tcp():
     """A UDP answer with TC set makes the client re-ask over TCP
     (mname-client behavior; RFC 1035 4.2.2 framing)."""
@@ -263,7 +381,9 @@ def test_truncation_falls_back_to_tcp():
 def test_decode_aaaa_cname_soa_and_compression():
     """Record decoding: AAAA, CNAME via compression pointer, SOA
     minimum; compression loops must raise, not spin."""
-    q = dc.build_query(7, 'x.example', 'AAAA')
+    # Plain form: q[12:] below must be exactly the question section
+    # (the EDNS default appends an OPT after it).
+    q = dc.build_query(7, 'x.example', 'AAAA', edns_size=None)
     name_off = 12  # question name starts right after the header
 
     # AAAA

@@ -434,6 +434,38 @@ def test_idle_pooled_connection_death_evicted():
 # ---------------------------------------------------------------------------
 # CueballSyncTransport: the synchronous twin (background loop thread)
 
+def test_codel_pool_still_honors_caller_pool_timeout():
+    """With targetClaimDelay set, the pool derives its own claim
+    deadline and forbids an explicit claim timeout — but the caller's
+    httpx.Timeout(pool=...) must still bind: the claim is raced
+    against it from OUTSIDE the pool and maps to PoolTimeout
+    (ADVICE r4: previously the configured timeout was silently
+    dropped and the claim was bounded only by CoDel's max-idle)."""
+    async def t():
+        srv, port = await _slow_server(3.0)
+        transport = CueballTransport({'spares': 1, 'maximum': 1,
+                                      'recovery': RECOVERY,
+                                      'targetClaimDelay': 2000})
+        async with httpx.AsyncClient(
+                transport=transport,
+                timeout=httpx.Timeout(5.0, pool=0.3)) as client:
+            first = asyncio.ensure_future(
+                client.get('http://127.0.0.1:%d/' % port))
+            await asyncio.sleep(0.2)   # first request owns the 1 conn
+            t0 = time.monotonic()
+            with pytest.raises(httpx.PoolTimeout):
+                await client.get('http://127.0.0.1:%d/' % port)
+            # Bounded by the caller's 0.3 s, NOT CoDel's 2 s horizon.
+            assert time.monotonic() - t0 < 1.5
+            first.cancel()
+            try:
+                await first
+            except (asyncio.CancelledError, httpx.TransportError):
+                pass
+        srv.close()
+    run_async(t())
+
+
 def test_sync_client_one_line_adoption():
     from cueball_tpu.integrations.httpx import CueballSyncTransport
 

@@ -103,6 +103,31 @@ def test_codel_tracks_target(target):
     _run_target(target)
 
 
+def test_pace_deficit_clamped_to_queue_worth():
+    """A healthy-but-never-empty stretch must not bank an unbounded
+    deficit: _pace_account clamps at +/- target * (queue_len + 1), so
+    the next real overload's shed threshold starts at most one
+    queue-repayment above target (pool._pace_account)."""
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2,
+                                targetClaimDelay=300)
+        # Simulate 30 minutes of below-target resolutions with an
+        # empty-but-armed queue: the deficit stays pinned at one
+        # queue's worth, not -9000 * 300ms.
+        for _ in range(9000):
+            pool._pace_account(-290.0)
+        assert pool.p_pace_sum_err == -300.0 * (len(pool.p_waiters) + 1)
+        comp = pool._pace_comp()
+        assert comp == 0.0          # no waiters -> no compensation
+        for _ in range(9000):
+            pool._pace_account(290.0)
+        assert pool.p_pace_sum_err == 300.0 * (len(pool.p_waiters) + 1)
+        pool.stop()
+        await settle()
+    run_async(t())
+
+
 def test_timeout_option_forbidden_with_codel():
     async def t():
         ctx = Ctx()
