@@ -35,7 +35,7 @@ import math
 from . import errors as mod_errors
 from . import utils as mod_utils
 from .events import _native
-from .fsm import FSM
+from .fsm import FSM, get_loop
 
 # FSM state-handle gates are framework-internal listeners; the native
 # Gate type carries no attributes, so recognize it by type.
@@ -394,11 +394,14 @@ class CueBallClaimHandle(FSM):
 
         self.ch_slot = None
         self.ch_waiter_node = None  # pool claim-queue node (O(1) unlink)
+        self.ch_requeue = None      # pool try_next; set AFTER init so
+        #                             only re-entries to waiting fire it
         self.ch_release_stack: list[str] | None = None
         self.ch_connection = None
         self.ch_pre_listeners: dict[str, int] = {}
         self.ch_cancelled = False
         self.ch_last_error = None
+        self._ch_arm_timer = None
         self.ch_do_release_leak_check = True
         self.ch_pinger = False
         self.ch_started = mod_utils.current_millis()
@@ -447,6 +450,31 @@ class CueBallClaimHandle(FSM):
         self.ch_do_release_leak_check = False
 
     disableReleaseLeakCheck = disable_release_leak_check
+
+    def arm_claim_timer(self) -> None:
+        """Called by the pool when this handle parks in the claim
+        queue: arm the claim timeout now (see state_waiting — claims
+        served without parking never pay for a timer)."""
+        arm = self._ch_arm_timer
+        if arm is not None:
+            self._ch_arm_timer = None
+            arm()
+
+    def _ch_unpark(self) -> None:
+        """O(1)-unlink this handle's claim-queue node, if parked. Runs
+        at entry to every state that leaves 'waiting', so a resolved
+        handle never stays pinned in the pool's wait queue until a
+        dequeue that may not come (the pool used to do this from a
+        per-claim stateChanged listener; owning it here saves that
+        subscription on the claim hot path). Also drops the un-fired
+        arm closure: it captures the waiting state's handle, and a
+        fast-path claim would otherwise pin that for the whole
+        lease."""
+        self._ch_arm_timer = None
+        node = self.ch_waiter_node
+        if node is not None:
+            node.remove()
+            self.ch_waiter_node = None
 
     # -- signal functions ------------------------------------------------
 
@@ -527,6 +555,12 @@ class CueBallClaimHandle(FSM):
         S.validTransitions(['claiming', 'cancelled', 'failed'])
 
         self.ch_slot = None
+        if self.ch_requeue is not None:
+            # Re-entry after a rejected claim: ask the pool to try
+            # again next tick (the initial entry runs during __init__,
+            # before the pool has installed ch_requeue — the pool
+            # schedules that first try itself).
+            get_loop().call_soon(self.ch_requeue)
 
         S.goto_state_on(self, 'tryAsserted', 'claiming')
 
@@ -535,9 +569,20 @@ class CueBallClaimHandle(FSM):
             self.ch_pool._incr_counter('claim-timeout')
             S.gotoState('failed')
 
-        if isinstance(self.ch_claim_timeout, (int, float)) and \
-                math.isfinite(self.ch_claim_timeout):
-            S.timeout(self.ch_claim_timeout, on_timeout)
+        # The timeout timer is armed LAZILY, by the pool, only when
+        # the handle actually parks in the wait queue
+        # (arm_claim_timer): a claim served from the idle queue never
+        # waits, and skipping the arm+cancel saves a TimerHandle
+        # alloc + timer-heap churn on every fast-path claim. The
+        # deadline stays measured from ch_started, so the deferred
+        # arm never extends it.
+        def _arm():
+            if isinstance(self.ch_claim_timeout, (int, float)) and \
+                    math.isfinite(self.ch_claim_timeout):
+                remaining = self.ch_claim_timeout - (
+                    mod_utils.current_millis() - self.ch_started)
+                S.timeout(max(remaining, 0.0), on_timeout)
+        self._ch_arm_timer = _arm
 
         S.on(self, 'timeout', on_timeout)
 
@@ -551,6 +596,7 @@ class CueBallClaimHandle(FSM):
     def state_claiming(self, S):
         S.validTransitions(['claimed', 'waiting', 'cancelled'])
 
+        self._ch_unpark()
         S.goto_state_on(self, 'accepted', 'claimed')
 
         def on_rejected():
@@ -613,11 +659,13 @@ class CueBallClaimHandle(FSM):
 
     def state_cancelled(self, S):
         S.validTransitions([])
+        self._ch_unpark()
         # Public API contract: the callback is never called after
         # cancel() (reference lib/connection-fsm.js:770-777).
 
     def state_failed(self, S):
         S.validTransitions([])
+        self._ch_unpark()
         S.immediate(lambda: self.ch_callback(self.ch_last_error))
 
 

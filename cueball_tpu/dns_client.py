@@ -306,8 +306,17 @@ class DnsClient:
         port = int(portstr) if portstr else 53
         qid = random.randrange(65536)
         payload = build_query(qid, domain, qtype)
+        # One DEADLINE for this resolver's whole attempt: the EDNS
+        # fallback and the TC->TCP retry each consume what remains,
+        # never a fresh slice — otherwise one resolver could stretch
+        # to 3x its budget and stall failover to the next wave.
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+
+        def left() -> float:
+            return max(deadline - loop.time(), 0.001)
         try:
-            data = await query_udp(host, port, payload, timeout_s)
+            data = await query_udp(host, port, payload, left())
             msg = parse_response(data)
             if msg.rcode in ('FORMERR', 'NOTIMP'):
                 # Legacy server/middlebox rejecting the OPT record:
@@ -317,10 +326,10 @@ class DnsClient:
                 qid = random.randrange(65536)
                 payload = build_query(qid, domain, qtype,
                                       edns_size=None)
-                data = await query_udp(host, port, payload, timeout_s)
+                data = await query_udp(host, port, payload, left())
                 msg = parse_response(data)
             if msg.tc:
-                data = await query_tcp(host, port, payload, timeout_s)
+                data = await query_tcp(host, port, payload, left())
                 msg = parse_response(data)
         except (asyncio.TimeoutError, TimeoutError):
             raise DnsTimeoutError(domain, resolver)

@@ -24,6 +24,7 @@ asyncio-native `claim()` coroutine wrapper returning (handle, connection).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import math
 import time
@@ -400,9 +401,10 @@ class ConnectionPool(FSM):
         if self.p_codel is None or \
                 self.is_in_state('stopping') or self.is_in_state('stopped'):
             return
-        # Resolved handles unlink themselves from p_waiters via the
-        # claim_cb waiting_listener, so the queue only holds live
-        # waiters here (modulo same-tick races handled below).
+        # Resolved handles unlink themselves from p_waiters at their
+        # own state entries (CueBallClaimHandle._ch_unpark), so the
+        # queue only holds live waiters here (modulo same-tick races
+        # handled below).
         if len(self.p_waiters) == 0:
             self._pace_reset()
             return
@@ -1035,22 +1037,19 @@ class ConnectionPool(FSM):
                 return
 
             handle.ch_waiter_node = self.p_waiters.push(handle)
+            handle.arm_claim_timer()
             self._hwm_counter('max-claim-queue', len(self.p_waiters))
             self._incr_counter('queued-claim')
             self._arm_codel_pacer()
             self.rebalance()
 
-        def waiting_listener(st):
-            if st == 'waiting':
-                try_next()
-            elif handle.ch_waiter_node is not None:
-                # The handle resolved (timeout/cancel/claiming) while
-                # queued: unlink its claim-queue node now, O(1), so a
-                # stalled pool never pins resolved handles until a
-                # dequeue that may not come.
-                handle.ch_waiter_node.remove()
-                handle.ch_waiter_node = None
-        handle.on('stateChanged', waiting_listener)
+        # First try runs next tick (the reference's deferred
+        # stateChanged('waiting') ordering); re-entries to 'waiting'
+        # (claim rejected) re-schedule via ch_requeue, and queue-node
+        # unlink on resolution lives in the handle's own state entries
+        # (_ch_unpark) — no per-claim stateChanged subscription.
+        handle.ch_requeue = try_next
+        get_loop().call_soon(try_next)
 
         return handle
 
@@ -1059,7 +1058,6 @@ class ConnectionPool(FSM):
         claim error otherwise. Cancelling the awaiting task cancels the
         claim (so the callback contract of the reference's
         waiter.cancel() maps onto task cancellation)."""
-        import asyncio
         loop = get_loop()
         fut: asyncio.Future = loop.create_future()
 

@@ -273,11 +273,31 @@ def test_claim_timeout_fails_handle():
         calls = []
         hdl = make_handle(pool, lambda err, h=None, c=None:
                           calls.append(err), timeout=30)
+        # The pool arms the timer when the handle parks in the wait
+        # queue (ConnectionPool.try_next); a handle served without
+        # ever parking pays for no timer at all.
+        hdl.arm_claim_timer()
         await asyncio.sleep(0.08)
         assert hdl.is_in_state('failed')
         assert len(calls) == 1
         assert isinstance(calls[0], mod_errors.ClaimTimeoutError)
         assert pool.counters.get('claim-timeout') == 1
+    run_async(t())
+
+
+def test_claim_timeout_deadline_measured_from_claim_start():
+    """arm_claim_timer arms with the REMAINING time: the deadline runs
+    from ch_started, so a deferred park cannot extend it."""
+    async def t():
+        pool = FakePool()
+        calls = []
+        hdl = make_handle(pool, lambda err, h=None, c=None:
+                          calls.append(err), timeout=100)
+        await asyncio.sleep(0.07)      # parked late: 70ms already gone
+        hdl.arm_claim_timer()
+        await asyncio.sleep(0.06)      # 130ms total > 100ms deadline
+        assert hdl.is_in_state('failed'), hdl.get_state()
+        assert isinstance(calls[0], mod_errors.ClaimTimeoutError)
     run_async(t())
 
 

@@ -328,6 +328,48 @@ def test_edns_formerr_falls_back_to_plain_query():
     run_async(t())
 
 
+def test_fallback_retries_share_one_resolver_deadline():
+    """The EDNS fallback (and TC->TCP) consume the resolver's
+    REMAINING budget, not a fresh slice: a server that FORMERRs fast
+    and then goes silent must fail the lookup in ~one timeout, not
+    two or three stacked ones."""
+    async def t():
+        import time as mod_time
+        loop = asyncio.get_running_loop()
+
+        class FormerrThenSilent(asyncio.DatagramProtocol):
+            def connection_made(self, transport):
+                self.transport = transport
+                self.sent = 0
+
+            def datagram_received(self, data, addr):
+                qid = struct.unpack('>H', data[:2])[0]
+                name, off = dc._decode_name(data, 12)
+                if self.sent == 0:
+                    self.sent += 1
+                    self.transport.sendto(
+                        struct.pack('>HHHHHH', qid, 0x8181, 1, 0, 0, 0)
+                        + data[12:off + 4], addr)
+                # plain-query retry: silence
+
+        transport, _ = await loop.create_datagram_endpoint(
+            FormerrThenSilent, local_addr=('127.0.0.1', 0))
+        port = transport.get_extra_info('sockname')[1]
+        client = dc.DnsClient()
+        fut = loop.create_future()
+        t0 = mod_time.monotonic()
+        client.lookup({'domain': 'silent.test', 'type': 'A',
+                       'timeout': 800,
+                       'resolvers': ['127.0.0.1@%d' % port]},
+                      lambda err, msg: fut.set_result((err, msg)))
+        err, msg = await asyncio.wait_for(fut, 5)
+        elapsed = mod_time.monotonic() - t0
+        assert isinstance(err, dc.DnsTimeoutError), err
+        assert elapsed < 1.6, 'deadline stacked: %.2fs' % elapsed
+        transport.close()
+    run_async(t())
+
+
 def test_truncation_falls_back_to_tcp():
     """A UDP answer with TC set makes the client re-ask over TCP
     (mname-client behavior; RFC 1035 4.2.2 framing)."""
