@@ -51,6 +51,7 @@ DOC_ROOTS = docs README.md
 
 docs-check:
 	$(PYTHON) tools/cbdocs.py check $(DOC_ROOTS)
+	$(PYTHON) tools/cbdocs.py api-coverage docs/api.md
 
 docs: docs-check
 	$(PYTHON) tools/cbdocs.py html docs/_site $(DOC_ROOTS)

@@ -318,6 +318,48 @@ def test_docs_html_renders_site(tmp_path):
     assert (out / 'b.html').exists()
 
 
+def test_api_coverage_gate_passes_on_repo_docs():
+    assert cbdocs.api_coverage(str(ROOT / 'docs' / 'api.md')) == 0
+
+
+def test_api_coverage_gate_fails_on_undocumented_export(tmp_path,
+                                                        capsys):
+    """Strip one real export's every mention from a copy of api.md:
+    the gate must name it and fail — a new export with no documented
+    contract cannot pass `make docs-check`."""
+    text = (ROOT / 'docs' / 'api.md').read_text(encoding='utf-8')
+    assert 'plan_rebalance' in text
+    # Both alias spellings collapse to one key: strip them both.
+    stripped = text.replace('plan_rebalance', 'x').replace(
+        'planRebalance', 'x')
+    bad = tmp_path / 'api.md'
+    bad.write_text(stripped, encoding='utf-8')
+    assert cbdocs.api_coverage(str(bad)) == 1
+    out = capsys.readouterr().out
+    assert 'cueball_tpu.plan_rebalance' in out
+
+
+def test_api_coverage_prose_words_do_not_count(tmp_path, capsys):
+    """Only code spans/fences/headings cover an export: a common-word
+    export (`Queue`) mentioned in plain prose is still flagged."""
+    text = (ROOT / 'docs' / 'api.md').read_text(encoding='utf-8')
+    # Remove the real Queue documentation, leave a prose-only mention.
+    stripped = text.replace('`cb.Queue`', 'the queue thing')
+    bad = tmp_path / 'api.md'
+    bad.write_text(stripped, encoding='utf-8')
+    assert cbdocs.api_coverage(str(bad)) == 1
+    assert 'cueball_tpu.Queue' in capsys.readouterr().out
+
+
+def test_api_coverage_alias_spellings_collapse():
+    """Documenting either spelling of a camelCase/snake_case alias
+    pair satisfies both (the docs state the alias convention once)."""
+    assert cbdocs._normalize('resolverForIpOrDomain') == \
+        cbdocs._normalize('resolver_for_ip_or_domain')
+    assert cbdocs._normalize('poolMonitor') == \
+        cbdocs._normalize('pool_monitor')
+
+
 def test_docs_cli_gate(tmp_path):
     (tmp_path / 'bad.md').write_text('[x](nope.md)\n')
     r = subprocess.run(
