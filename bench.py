@@ -449,37 +449,73 @@ def bench_telemetry_stages(emit, pools=TELEM_POOLS):
 
     # Live sampler tick cost: what one FleetSampler.sample_once costs
     # end to end (VERDICT r4 item 2), gather decomposed out.
-    from cueball_tpu.monitor import PoolMonitor
-    from cueball_tpu.parallel.sampler import FleetSampler
-    from cueball_tpu.utils import current_millis
     sizes = TELEM_TICK_SIZES
     if os.environ.get('CUEBALL_BENCH_TICKS'):
         sizes = tuple(int(v) for v in
                       os.environ['CUEBALL_BENCH_TICKS'].split(','))
     emit({'stage': 'tick_sizes', 'tick_sizes': list(sizes)})
     for n in sizes:
-        mon = PoolMonitor()
-        fleet = [_BenchPool(i) for i in range(n)]
-        for p in fleet:
-            mon.register_pool(p)
-        s = FleetSampler({'monitor': mon, 'capacity': n})
-        s.sample_once()                  # compile
-        s.sample_once()                  # warm transfer cache
-        iters = 5
-        t0 = time.perf_counter()
-        for k in range(iters):
-            for p in fleet[::97]:        # loads move between ticks
-                p.load = float((p.load + k + 1) % 8)
-            s.sample_once()
-        tick_us = (time.perf_counter() - t0) / iters * 1e6
-        now = current_millis()
-        t0 = time.perf_counter()
-        for p in fleet:
-            FleetSampler.gather_pool(p, now)
-        gather_us = (time.perf_counter() - t0) * 1e6
+        tick_us, gather_us = _measure_tick_cost(n)
         emit({'stage': 'tick_cost_%d' % n,
               'tick_us_%d' % n: tick_us,
               'gather_us_%d' % n: gather_us})
+
+
+def _measure_tick_cost(n: int) -> tuple:
+    """(tick_us, gather_us) for one FleetSampler over n synthetic
+    pools — ONE protocol shared by the chip stage and the host copy,
+    so the two numbers always measure the same thing."""
+    from cueball_tpu.monitor import PoolMonitor
+    from cueball_tpu.parallel.sampler import FleetSampler
+    from cueball_tpu.utils import current_millis
+    mon = PoolMonitor()
+    fleet = [_BenchPool(i) for i in range(n)]
+    for p in fleet:
+        mon.register_pool(p)
+    s = FleetSampler({'monitor': mon, 'capacity': n})
+    s.sample_once()                  # compile
+    s.sample_once()                  # warm transfer cache
+    iters = 5
+    t0 = time.perf_counter()
+    for k in range(iters):
+        for p in fleet[::97]:        # loads move between ticks
+            p.load = float((p.load + k + 1) % 8)
+        s.sample_once()
+    tick_us = (time.perf_counter() - t0) / iters * 1e6
+    now = current_millis()
+    t0 = time.perf_counter()
+    for p in fleet:
+        FleetSampler.gather_pool(p, now)
+    return tick_us, (time.perf_counter() - t0) * 1e6
+
+
+def bench_sampler_tick_host(sizes=(1024, 10240)) -> dict:
+    """Sampler tick cost on the HOST CPU backend: wall us per
+    FleetSampler.sample_once over N synthetic pools, gather timed
+    separately (same protocol as the chip stage via
+    _measure_tick_cost). The chip stage measures the accelerator;
+    this host copy guarantees the round's JSON carries tick numbers
+    even when the tunnel is wedged (two straight rounds of that) —
+    so it must pin CPU ITSELF: the container sitecustomize
+    force-registers the TPU backend, and a wedged tunnel blocks
+    backend init indefinitely."""
+    try:
+        import jax
+    except ImportError:
+        return {}
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except RuntimeError:
+        # Backends already initialized; if that wasn't CPU we must
+        # not touch the device path here.
+        if jax.default_backend() != 'cpu':
+            return {}
+    out = {}
+    for n in sizes:
+        tick_us, gather_us = _measure_tick_cost(n)
+        out['tick_us_%d' % n] = tick_us
+        out['gather_us_%d' % n] = gather_us
+    return out
 
 
 def _telemetry_child_main(progress_path: str) -> None:
@@ -669,6 +705,7 @@ async def main():
     (claim_mean, claim_stdev, claim_trials,
      claim_diags) = await bench_claim_throughput()
     queued_mean, queued_stdev = await bench_queued_claim_throughput()
+    host_tick = bench_sampler_tick_host()
     telem = bench_telemetry_step_guarded()
 
     result = {
@@ -713,6 +750,12 @@ async def main():
             if k.startswith('tick_us_')},
         'telemetry_gather_us': {
             k[len('gather_us_'):]: _r(v) for k, v in telem.items()
+            if k.startswith('gather_us_')},
+        'sampler_tick_host_us': {
+            k[len('tick_us_'):]: _r(v) for k, v in host_tick.items()
+            if k.startswith('tick_us_')},
+        'sampler_gather_host_us': {
+            k[len('gather_us_'):]: _r(v) for k, v in host_tick.items()
             if k.startswith('gather_us_')},
         'telemetry_stages_completed': telem.get('stages_completed'),
         'telemetry_code_hash': telemetry_code_hash(),

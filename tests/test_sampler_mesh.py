@@ -158,6 +158,39 @@ class FakePool:
         return self._load
 
 
+def test_2d_mesh_sampler_decisions_match_plain():
+    """The live sampler over a 2-D ('host', 'chip') mesh — pools
+    sharded over BOTH axes, aggregates reducing hierarchically — must
+    publish the same decisions as an unsharded sampler over the same
+    fake fleet (the live analogue of the dryrun's mesh2 leg)."""
+    from jax.sharding import Mesh
+    devs = jax.devices()[:8]
+    mesh2 = Mesh(np.array(devs).reshape(2, 4), ('host', 'chip'))
+    mon = PoolMonitor()
+    fleet = [FakePool(load=float(i % 7)) for i in range(12)]
+    for p in fleet:
+        mon.register_pool(p)
+    meshed = FleetSampler({'monitor': mon, 'mesh': mesh2,
+                           'meshAxes': ('host', 'chip')})
+    plain = FleetSampler({'monitor': mon})
+    for k in range(6):
+        for i, p in enumerate(fleet[::3]):
+            p._load = float((i + k) % 9)
+        rec_m = meshed.sample_once()
+        rec_p = plain.sample_once()
+        for uuid, got in rec_m['pools'].items():
+            want = rec_p['pools'][uuid]
+            for key in ('filtered', 'target', 'retry_backoff'):
+                assert got[key] == pytest.approx(
+                    want[key], rel=1e-5, abs=1e-5), (uuid, k, key)
+        for key, v in rec_p['fleet'].items():
+            assert rec_m['fleet'][key] == pytest.approx(
+                v, rel=1e-5, abs=1e-5), (k, key)
+    assert meshed.fs_capacity % 8 == 0
+    assert len(meshed.fs_state.windows.sharding.device_set) == 8
+    assert meshed.snapshot()['mesh']['shape'] == {'host': 2, 'chip': 4}
+
+
 def test_mesh_capacity_rounds_up_and_grows():
     mesh = pools_mesh()
     mon = PoolMonitor()

@@ -348,11 +348,19 @@ def api_coverage(api_path: str) -> int:
     missing = []
     skipped = []
     total = 0
+    optional_deps = {'jax', 'jaxlib', 'httpx', 'aiohttp'}
     for modname in API_MODULES:
         try:
             mod = importlib.import_module(modname)
         except ImportError as e:
-            skipped.append('%s (%s)' % (modname, e.name or e))
+            # ONLY a missing optional host dependency may skip a
+            # module; a broken cueball_tpu import must fail the gate,
+            # not pass it vacuously.
+            dep = (e.name or '').partition('.')[0]
+            if dep not in optional_deps:
+                print('cbdocs: cannot import %s: %s' % (modname, e))
+                return 1
+            skipped.append('%s (%s)' % (modname, dep))
             continue
         for name in _public_names(mod):
             total += 1
