@@ -214,6 +214,41 @@ def test_mesh_capacity_rounds_up_and_grows():
     assert rec['fleet']['mean_load'] == pytest.approx(3.0, rel=1e-6)
 
 
+def test_mesh_row_recycle_resets_sharded_state():
+    """Row lifecycle on the mesh path: a departed pool's row is
+    reassigned to a newcomer with a clean (reset) filter window even
+    though the carried state lives sharded across 8 devices."""
+    mesh = pools_mesh()
+    mon = PoolMonitor()
+    a = FakePool(load=6.0)
+    b = FakePool(load=1.0)
+    mon.register_pool(a)
+    mon.register_pool(b)
+    # Occupy every other row too (mesh capacity is at least the mesh
+    # size, so the free list only empties with a full fleet — a
+    # retired row is then genuinely REASSIGNED, not just unused).
+    for _ in range(6):
+        mon.register_pool(FakePool(load=1.0))
+    s = FleetSampler({'monitor': mon, 'mesh': mesh, 'record': True})
+    for _ in range(6):
+        rec = s.sample_once()
+    row_a = s.fs_rows[a.p_uuid]
+    filt_a = rec['pools'][a.p_uuid]['filtered']
+    assert filt_a > 0.2    # window accumulated a's heavy load
+
+    mon.unregister_pool(a)
+    c = FakePool(load=1.0)
+    mon.register_pool(c)
+    rec = s.sample_once()
+    assert s.fs_rows[c.p_uuid] == row_a     # row inherited...
+    # ...with cleared state: one tick of load=1 through a fresh
+    # window reads far below a's accumulated filter value.
+    assert rec['pools'][c.p_uuid]['filtered'] < filt_a / 2
+    # b's window carried over untouched.
+    assert rec['pools'][b.p_uuid]['filtered'] > 0.2
+    assert len(s.fs_state.windows.sharding.device_set) == 8
+
+
 def test_snapshot_reports_mesh_shape():
     mesh = pools_mesh()
     s = FleetSampler({'monitor': PoolMonitor(), 'mesh': mesh})
