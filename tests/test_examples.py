@@ -103,6 +103,19 @@ def test_inference_fleet_client_example():
     assert "'mean_load'" in r.stdout
 
 
+def test_fleet_mesh_sampler_example():
+    pytest.importorskip('jax')  # mesh demo is jax through and through
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, 'examples', 'fleet_mesh_sampler.py')],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'})
+    assert r.returncode == 0, r.stderr
+    assert 'sharded over 8 devices' in r.stdout
+    assert '40/40 ticks agree' in r.stdout
+    assert 'mesh sampler demo ok' in r.stdout
+
+
 def test_telemetry_replay_example():
     pytest.importorskip('jax')
     driver = (

@@ -276,6 +276,58 @@ def test_tick_donates_state_buffers():
         assert not s.fs_state.windows.is_deleted()
 
 
+def test_live_step_memoized_across_samplers():
+    """Every sampler over the same (mesh, axes) shares ONE compiled
+    tick program (make_live_step is memoized) — N samplers in a
+    process must not pay N traces+compiles."""
+    from cueball_tpu.parallel.telemetry import make_live_step
+    mesh = pools_mesh()
+    a = FleetSampler({'monitor': PoolMonitor(), 'mesh': mesh})
+    b = FleetSampler({'monitor': PoolMonitor(), 'mesh': mesh})
+    for s in (a, b):
+        s.fs_monitor.register_pool(FakePool())
+        s.sample_once()
+    assert a.fs_step is b.fs_step
+    assert a.fs_step is make_live_step(mesh, ('pools',))
+    p = FleetSampler({'monitor': PoolMonitor()})
+    q = FleetSampler({'monitor': PoolMonitor()})
+    p.sample_once()      # plain samplers share the unsharded program
+    q.sample_once()
+    assert p.fs_step is q.fs_step
+    assert p.fs_step is not a.fs_step
+
+
+def test_actuation_through_mesh_sampler():
+    """The closed loop (sampler advisory -> pool shrink clamp) works
+    identically when the sampler runs the sharded step: after the
+    warm-up gate a fleetActuation pool receives the mesh-computed
+    filtered value as its advisory."""
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=1, maximum=4,
+                                fleetActuation=True)
+        inner.emit('added', 'a1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+
+        mon = PoolMonitor()
+        mon.register_pool(pool)
+        s = FleetSampler({'monitor': mon, 'mesh': pools_mesh(),
+                          'actuate': True, 'taps': 4})
+        for _ in range(6):       # warm-up gate = taps(4) ticks
+            await asyncio.sleep(0.01)
+            rec = s.sample_once()
+        adv = pool.p_fleet_advisory
+        assert adv is not None
+        assert adv[0] == pytest.approx(
+            rec['pools'][pool.p_uuid]['filtered'], rel=1e-6)
+        pool.stop()
+        await settle(30)
+    run_async(t())
+
+
 def test_step_failure_recovers_next_tick():
     """A transient step failure must not brick the sampler: donation
     invalidates the carried buffers at dispatch, so after a raise the
@@ -307,6 +359,79 @@ def test_step_failure_recovers_next_tick():
     assert rec['fleet']['n_pools'] == 1
     assert s.fs_rows[fake.p_uuid] == row
     assert not s.fs_state.windows.is_deleted()
+
+
+class FakeWaiter:
+    def __init__(self, started):
+        self.ch_started = started
+
+    def is_in_state(self, st):
+        return st == 'waiting'
+
+
+def test_mesh_churn_soak_matches_plain(frozen_clock):
+    """200 ticks of seeded fleet churn — pools arriving/leaving (rows
+    grow, recycle, reset), loads moving, CoDel targets and live
+    queue sojourns on some pools — and the meshed sampler's published
+    decisions match the plain sampler's on every tick. The mesh-path
+    analogue of the seeded soak suites: one wrong reset mask, grow
+    re-placement, or transfer-cache reuse diverges the streams."""
+
+    class Codel:
+        def __init__(self, t):
+            self.cd_targdelay = t
+
+    rng = np.random.default_rng(42)
+    mon = PoolMonitor()
+    meshed = FleetSampler({'monitor': mon, 'mesh': pools_mesh()})
+    plain = FleetSampler({'monitor': mon})
+    fleet = []
+
+    def spawn():
+        p = FakePool(load=float(rng.uniform(0, 8)))
+        if rng.uniform() < 0.4:
+            p.p_codel = Codel(float(rng.choice([300.0, 1000.0])))
+        fleet.append(p)
+        mon.register_pool(p)
+
+    for _ in range(4):
+        spawn()
+    drops_seen = 0
+    for tick in range(200):
+        frozen_clock.advance(100)
+        # Churn: arrivals/departures, moving loads, queue pressure.
+        if rng.uniform() < 0.15 and len(fleet) < 40:
+            spawn()
+        if rng.uniform() < 0.08 and len(fleet) > 2:
+            gone = fleet.pop(int(rng.integers(len(fleet))))
+            mon.unregister_pool(gone)
+        for p in fleet:
+            if rng.uniform() < 0.3:
+                p._load = float(rng.uniform(0, 8))
+            if p.p_codel is not None:
+                p.p_waiters = [FakeWaiter(
+                    frozen_clock() - float(rng.uniform(0, 1500)))] \
+                    if rng.uniform() < 0.5 else []
+        rec_m = meshed.sample_once()
+        rec_p = plain.sample_once()
+        assert set(rec_m['pools']) == set(rec_p['pools']), tick
+        for uuid, got in rec_m['pools'].items():
+            want = rec_p['pools'][uuid]
+            assert got['inputs'] == want['inputs'], (tick, uuid)
+            for key in ('filtered', 'target', 'retry_backoff'):
+                assert got[key] == pytest.approx(
+                    want[key], rel=1e-5, abs=1e-5), (tick, uuid, key)
+            assert got['drop'] == want['drop'], (tick, uuid)
+            drops_seen += got['drop']
+        for key, v in rec_p['fleet'].items():
+            assert rec_m['fleet'][key] == pytest.approx(
+                v, rel=1e-5, abs=1e-5), (tick, key)
+
+    assert meshed.fs_capacity >= 32          # growth really happened
+    assert meshed.fs_capacity % 8 == 0
+    assert len(meshed.fs_state.windows.sharding.device_set) == 8
+    # The CoDel law was genuinely live during the soak.
+    assert drops_seen > 0
 
 
 def test_input_cache_reships_only_changed_columns():
