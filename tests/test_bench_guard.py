@@ -61,29 +61,20 @@ def test_citation_cites_only_hash_matched_artifacts(tmp_path):
     assert 'predates the code-hash guard' in stale['note']
 
 
-def test_partial_stages_survive_a_mid_run_kill():
+def test_partial_stages_survive_a_mid_run_kill(monkeypatch):
     """The round-4/5 failure mode: the watchdog kills a wedged chip
     run. The staged protocol's whole point is that every stage that
     completed before the kill is still read back from the progress
     file — a 20 s budget on CPU lands the cheap probe stages but not
-    the full-size steps, and those partials (plus the error) must
+    the whole stage list, and those partials (plus the error) must
     appear in the guarded result."""
     pytest.importorskip('jax')
-    saved = {k: os.environ.get(k) for k in (
-        'JAX_PLATFORMS', 'CUEBALL_BENCH_POOLS', 'CUEBALL_BENCH_TICKS')}
-    os.environ['JAX_PLATFORMS'] = 'cpu'   # child honors explicit CPU
+    monkeypatch.setenv('JAX_PLATFORMS', 'cpu')
     # Pin the default (full-size) shapes: an inherited fast-CI
     # override would let the child finish inside the budget.
-    os.environ.pop('CUEBALL_BENCH_POOLS', None)
-    os.environ.pop('CUEBALL_BENCH_TICKS', None)
-    try:
-        telem = bench.bench_telemetry_step_guarded(timeout_s=20.0)
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+    monkeypatch.delenv('CUEBALL_BENCH_POOLS', raising=False)
+    monkeypatch.delenv('CUEBALL_BENCH_TICKS', raising=False)
+    telem = bench.bench_telemetry_step_guarded(timeout_s=20.0)
     stages = telem.get('stages_completed') or []
     assert 'error' in telem        # the watchdog fired...
     assert 'timed out' in telem['error']
@@ -91,9 +82,6 @@ def test_partial_stages_survive_a_mid_run_kill():
     assert telem.get('backend') == 'cpu'
     assert 'dispatch_floor' in stages
     assert telem.get('dispatch_floor_us') > 0
-    # And the full-size stage can't have finished in 20 s on CPU.
-    assert telem.get('pools_per_sec_live') is None or \
-        'step_xla' not in stages
 
 
 def test_committed_artifact_if_present_is_not_stale():
