@@ -4,7 +4,8 @@ PYTHON ?= python3
 LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
-.PHONY: test check bench dryrun coverage native ci docs docs-check
+.PHONY: test check bench bench-host dryrun coverage native ci docs \
+	docs-check
 
 native:
 	$(PYTHON) native/build.py
@@ -28,6 +29,12 @@ ci: native check docs-check
 
 bench:
 	$(PYTHON) bench.py
+
+# Host-path stages only (codel tracking, claim throughput, sampler
+# tick cost): no accelerator, no chip subprocess, no 300s telemetry
+# timeout. Emits the same single JSON line with host_only=true.
+bench-host:
+	$(PYTHON) bench.py --host-only
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -324,12 +324,15 @@ def telemetry_code_hash() -> str:
 
 class _BenchPool:
     """The minimal pool surface FleetSampler.gather_pool reads, so the
-    tick-cost stage can weigh the REAL sampler path (Python gather +
+    tick-cost stage can weigh the REAL sampler path (dirty-row patch +
     placement + donated step + publish) at fleet sizes no process
-    would build real pools for."""
+    would build real pools for. Speaks the push-telemetry protocol
+    (telemetry_attach/mark_dirty) like a real ConnectionPool, so the
+    tick bench measures the O(changed) event-driven path — the
+    whole-fleet re-walk it replaced is what the bench used to time."""
 
     __slots__ = ('p_uuid', 'p_spares', 'p_max', 'p_codel', 'p_waiters',
-                 'p_connections', 'load')
+                 'p_connections', 'load', 'handles')
 
     def __init__(self, i):
         self.p_uuid = 'bench-%d' % i
@@ -339,9 +342,22 @@ class _BenchPool:
         self.p_waiters = ()
         self.p_connections = {}
         self.load = float(i % 8)
+        self.handles = ()
 
     def lp_load_sample(self):
         return self.load
+
+    def telemetry_attach(self, handle):
+        self.handles = self.handles + (handle,)
+
+    def telemetry_detach(self, handle):
+        self.handles = tuple(
+            h for h in self.handles if h is not handle)
+
+    def set_load(self, v):
+        self.load = v
+        for h in self.handles:
+            h.mark_dirty()
 
 
 def bench_telemetry_stages(emit, pools=TELEM_POOLS):
@@ -479,7 +495,7 @@ def _measure_tick_cost(n: int) -> tuple:
     t0 = time.perf_counter()
     for k in range(iters):
         for p in fleet[::97]:        # loads move between ticks
-            p.load = float((p.load + k + 1) % 8)
+            p.set_load(float((p.load + k + 1) % 8))
         s.sample_once()
     tick_us = (time.perf_counter() - t0) / iters * 1e6
     now = current_millis()
@@ -681,33 +697,15 @@ def artifact_citation(root: str | None = None) -> dict:
     }}
 
 
-async def main():
-    # Pin THIS process to CPU: the host benchmarks must not share the
-    # GIL with the axon tunnel machinery (its retry threads measurably
-    # depress claim throughput when the chip tunnel is unhealthy). The
-    # telemetry stage reaches the chip from its own subprocess.
-    try:
-        import jax
-        jax.config.update('jax_platforms', 'cpu')
-    except Exception:
-        pass
-    # Pin to ONE core (the highest-numbered, away from irq-heavy core
-    # 0): the host benches are single-threaded asyncio, and scheduler
-    # migrations were a suspect in BENCH_r03's bimodal trials. The
-    # telemetry subprocess resets its own affinity (it wants the
-    # compiler's threads spread out).
-    try:
-        os.sched_setaffinity(0, {max(os.sched_getaffinity(0))})
-    except (AttributeError, OSError):
-        pass
+def assemble_result(abs_err, claim, queued, host_tick, telem) -> dict:
+    """Build the single JSON-line result from the stage outputs.
 
-    abs_err = await bench_codel_tracking()
-    (claim_mean, claim_stdev, claim_trials,
-     claim_diags) = await bench_claim_throughput()
-    queued_mean, queued_stdev = await bench_queued_claim_throughput()
-    host_tick = bench_sampler_tick_host()
-    telem = bench_telemetry_step_guarded()
-
+    Factored out of main() so the guard tests can assert the
+    assembly invariant directly: the host-path fields land in the
+    result even when the chip stage errored or was skipped entirely
+    (`telem` carrying only an 'error', or empty for --host-only)."""
+    claim_mean, claim_stdev, claim_trials, claim_diags = claim
+    queued_mean, queued_stdev = queued
     result = {
         'metric': 'codel_claim_delay_abs_error_ms',
         'value': round(abs_err, 2),
@@ -766,8 +764,47 @@ async def main():
         result['telemetry_error'] = telem['error']
     if telem.get('pools_per_sec_live') is None:
         result.update(artifact_citation())
+    return result
+
+
+async def main(host_only: bool = False):
+    """Run the bench and print ONE JSON line.
+
+    host_only=True (the `make bench-host` / --host-only path) runs
+    every host-CPU stage — codel tracking, claim throughput, the
+    sampler tick cost — and skips the chip subprocess entirely: no
+    accelerator touched, no 300 s telemetry timeout to wait out."""
+    # Pin THIS process to CPU: the host benchmarks must not share the
+    # GIL with the axon tunnel machinery (its retry threads measurably
+    # depress claim throughput when the chip tunnel is unhealthy). The
+    # telemetry stage reaches the chip from its own subprocess.
+    try:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+    # Pin to ONE core (the highest-numbered, away from irq-heavy core
+    # 0): the host benches are single-threaded asyncio, and scheduler
+    # migrations were a suspect in BENCH_r03's bimodal trials. The
+    # telemetry subprocess resets its own affinity (it wants the
+    # compiler's threads spread out).
+    try:
+        os.sched_setaffinity(0, {max(os.sched_getaffinity(0))})
+    except (AttributeError, OSError):
+        pass
+
+    abs_err = await bench_codel_tracking()
+    claim = await bench_claim_throughput()
+    queued = await bench_queued_claim_throughput()
+    host_tick = bench_sampler_tick_host()
+    telem = {} if host_only else bench_telemetry_step_guarded()
+
+    result = assemble_result(abs_err, claim, queued, host_tick, telem)
+    if host_only:
+        result['host_only'] = True
     print(json.dumps(result))
 
 
 if __name__ == '__main__':
-    asyncio.run(main())
+    import sys
+    asyncio.run(main(host_only='--host-only' in sys.argv[1:]))

@@ -187,6 +187,15 @@ class SocketMgrFSM(FSM):
 
     resetBackoff = reset_backoff
 
+    def _sm_telemetry_dirty(self) -> None:
+        """Flag the owning pool's fleet-telemetry row stale. Called on
+        entry to and exit from 'backoff' — the only transitions that
+        move the retry-ladder signals the FleetSampler columns carry.
+        Guarded getattr: ConnectionSet slots hand a cset as 'pool'."""
+        dirty = getattr(self.sm_pool, '_telemetry_dirty', None)
+        if dirty is not None:
+            dirty()
+
     def set_unwanted(self) -> None:
         """Forward to the current socket if it supports it
         (reference lib/connection-fsm.js:211-222)."""
@@ -242,6 +251,7 @@ class SocketMgrFSM(FSM):
 
     def state_connecting(self, S):
         S.validTransitions(['connected', 'error'])
+        self._sm_telemetry_dirty()   # may be leaving 'backoff'
 
         def on_timeout():
             self.sm_last_error = mod_errors.ConnectionTimeoutError(
@@ -318,6 +328,7 @@ class SocketMgrFSM(FSM):
 
     def state_backoff(self, S):
         S.validTransitions(['failed', 'connecting', 'closed'])
+        self._sm_telemetry_dirty()   # ladder position becomes visible
 
         # "retries" means "attempts" in the cueball API; compare to 1
         # (reference lib/connection-fsm.js:365-371).
@@ -341,6 +352,7 @@ class SocketMgrFSM(FSM):
 
     def state_closed(self, S):
         S.validTransitions(['backoff', 'connecting'])
+        self._sm_telemetry_dirty()   # may be leaving 'backoff'
         if self.sm_socket is not None:
             self.sm_socket.destroy()
         self.sm_socket = None
@@ -350,6 +362,7 @@ class SocketMgrFSM(FSM):
 
     def state_failed(self, S):
         S.validTransitions([])
+        self._sm_telemetry_dirty()   # leaving 'backoff'
         self.sm_log.warning(
             'failed to connect to backend, retries exhausted: %r',
             self.sm_last_error)
@@ -475,6 +488,12 @@ class CueBallClaimHandle(FSM):
         if node is not None:
             node.remove()
             self.ch_waiter_node = None
+            # The claim queue's head (and so the head sojourn the
+            # fleet sampler publishes) may have moved; flag the row.
+            # Guarded: ConnectionSet claims hand a cset as 'pool'.
+            dirty = getattr(self.ch_pool, '_telemetry_dirty', None)
+            if dirty is not None:
+                dirty()
 
     # -- signal functions ------------------------------------------------
 

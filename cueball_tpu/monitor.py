@@ -20,6 +20,10 @@ class PoolMonitor:
         self.pm_sets: dict[str, object] = {}
         self.pm_dns_res: dict[str, object] = {}
         self.pm_fleet = None  # attached FleetSampler, if any
+        # Bumped on every pool (un)registration so the FleetSampler can
+        # skip its row-reconcile walk on ticks where the fleet roster is
+        # unchanged (the overwhelmingly common case).
+        self.pm_generation = 0
 
     # -- fleet telemetry bridge ------------------------------------------
 
@@ -46,12 +50,14 @@ class PoolMonitor:
 
     def register_pool(self, pool) -> None:
         self.pm_pools[pool.p_uuid] = pool
+        self.pm_generation += 1
 
     registerPool = register_pool
 
     def unregister_pool(self, pool) -> None:
         assert pool.p_uuid in self.pm_pools
         del self.pm_pools[pool.p_uuid]
+        self.pm_generation += 1
 
     unregisterPool = unregister_pool
 

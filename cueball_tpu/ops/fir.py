@@ -10,9 +10,12 @@ The pool damps shrinkage with a 128-tap EMA FIR sampled at 5 Hz
 - :func:`fir_smooth` — full filtered history for offline analysis.
 - :func:`fir_apply_pallas` — the same matvec as a pallas TPU kernel
   (VMEM-blocked over pools; K=128 lands exactly on the lane width).
-  Measured 1.29x the XLA einsum on TPU v5 lite (19.4M vs 15.0M
-  pools/s through the full fleet_step, BENCH_TPU.json), so it is the
-  telemetry default on TPU (parallel/telemetry.py _default_fir);
+  A round-4 capture (archived as BENCH_TPU_r04.json) measured it at
+  1.29x the XLA einsum on TPU v5 lite, but that artifact predates the
+  code-hash guard and is NOT verified against the current measured
+  path — bench.py refuses to cite it until tools/chip_bench.py
+  re-captures with a hash. It remains the telemetry default on TPU
+  (parallel/telemetry.py _default_fir) pending re-measurement;
   off-TPU it only runs interpreted and the einsum is the default.
 """
 

@@ -2,7 +2,7 @@
 
 The reference runs each pool's control laws per-pool, in-process, on a
 5 Hz timer (reference lib/pool.js:251-262). The FleetSampler batches
-that loop: every tick it gathers, from every ConnectionPool registered
+that loop: every tick it feeds, for every ConnectionPool registered
 in the process-global :data:`cueball_tpu.monitor.pool_monitor`, exactly
 the signals the pool's own Python laws consume —
 
@@ -25,12 +25,30 @@ Rows: pools get stable rows in fixed-capacity arrays (capacity doubles
 as the fleet grows, which is the only recompile); departed pools free
 their row and the `reset` mask clears carried filter/CoDel state when
 a row is reassigned.
+
+Incremental gather: the per-pool signals live in preallocated numpy
+columns owned by the sampler, maintained *event-driven* rather than
+re-walked every tick. On row assignment the pool receives a
+:class:`TelemetryRowHandle`; the pool (and its slots and claim
+handles) call ``mark_dirty()`` at exactly the moments a gathered
+signal can move — slot state changes, claim enqueue/dequeue, backoff
+ladder entry/exit, spares/max reconfiguration — and ``sample_once``
+re-reads (via :meth:`FleetSampler.gather_pool_signals`, the same
+formulas as the oracle :meth:`FleetSampler.gather_pool`) only the
+dirty rows. The only per-tick vectorized work over the full capacity
+is the head-sojourn column (``now - head_ts``) and the column copies
+handed to the transfer cache, so the host tick is O(dirty rows), not
+O(fleet). Pools lacking the push protocol (duck-typed on
+``telemetry_attach``) fall back to being re-gathered every tick.
+See docs/fleet-telemetry.md for the full column/dirty contract.
 """
 
 from __future__ import annotations
 
+import heapq
 import math
 import typing
+from collections.abc import Mapping
 
 from .. import utils as mod_utils
 from ..events import EventEmitter
@@ -57,6 +75,102 @@ _FLEET_GAUGES = {
     'retry_frac': 'fraction of pools with slots in retry backoff',
     'mean_retry_backoff': 'mean reproduced backoff delay (ms)',
 }
+
+# Per-row defaults for the event-maintained signal columns: the values
+# an unoccupied (or just-released) row carries. target_delay=inf means
+# "CoDel off" in the batched law.
+_COL_DEFAULTS = {
+    'samples': 0.0,
+    'target_delay': math.inf,
+    'spares': 0.0,
+    'maximum': 0.0,
+    'retry_delay': 0.0,
+    'retry_max_delay': 0.0,
+    'retry_attempt': 0.0,
+    'n_retrying': 0.0,
+}
+
+
+class TelemetryRowHandle:
+    """A pool's write capability into its sampler's dirty set.
+
+    Handed to the pool at row assignment (``pool.telemetry_attach``).
+    ``mark_dirty()`` is the whole push protocol: one O(1) set-add, no
+    payload — the sampler re-reads the pool's signals itself on the
+    next tick. Events may fire many times between ticks; the set
+    dedupes them into a single re-gather. ``detach()`` (called by the
+    sampler when the row is freed) turns the handle inert, so a pool
+    that outlives its row — or that a second sampler still tracks —
+    can keep calling mark_dirty() safely."""
+
+    __slots__ = ('th_row', 'th_dirty')
+
+    def __init__(self, row: int, dirty: set):
+        self.th_row = row
+        self.th_dirty = dirty
+
+    def mark_dirty(self) -> None:
+        d = self.th_dirty
+        if d is not None:
+            d.add(self.th_row)
+
+    def detach(self) -> None:
+        self.th_dirty = None
+
+
+class _TickPools(Mapping):
+    """Lazy per-pool decision mapping for one tick's record.
+
+    Building 10k+ eagerly-materialized per-pool dicts every 200 ms
+    would put the publish path right back at O(fleet); this view
+    renders a pool's entry only when someone actually reads it (tests,
+    the kang snapshot — which materializes, or an operator poking
+    fs_latest). The backing arrays are the tick's committed copies, so
+    the view stays frozen even as the live columns keep moving."""
+
+    __slots__ = ('tp_rows', 'tp_cols', 'tp_out')
+
+    def __init__(self, rows: dict, cols: dict, out: dict):
+        self.tp_rows = rows
+        self.tp_cols = cols
+        self.tp_out = out
+
+    def __len__(self):
+        return len(self.tp_rows)
+
+    def __iter__(self):
+        return iter(self.tp_rows)
+
+    def __contains__(self, uuid):
+        return uuid in self.tp_rows
+
+    def __getitem__(self, uuid):
+        row = self.tp_rows[uuid]
+        cols = self.tp_cols
+        out = self.tp_out
+        # target_delay=inf means "CoDel off" in the arrays; publish
+        # None instead (Infinity is not valid JSON and the kang
+        # surface is read by strict external parsers).
+        td = float(cols['target_delay'][row])
+        return {
+            'row': row,
+            'inputs': {
+                'sample': float(cols['samples'][row]),
+                'sojourn': float(cols['sojourns'][row]),
+                'target_delay': td if math.isfinite(td) else None,
+                'spares': float(cols['spares'][row]),
+                'maximum': float(cols['maximum'][row]),
+                'retry_delay': float(cols['retry_delay'][row]),
+                'retry_max_delay': float(cols['retry_max_delay'][row]),
+                'retry_attempt': float(cols['retry_attempt'][row]),
+                'n_retrying': float(cols['n_retrying'][row]),
+            },
+            'filtered': float(out['filtered'][row]),
+            'target': float(out['target'][row]),
+            'clamped': bool(out['clamped'][row]),
+            'drop': bool(out['drop'][row]),
+            'retry_backoff': float(out['retry_backoff'][row]),
+        }
 
 
 class FleetSampler:
@@ -107,8 +221,24 @@ class FleetSampler:
         self.fs_epoch = mod_utils.current_millis()
         self.fs_rows: dict[str, int] = {}      # pool uuid -> row
         self.fs_row_ticks: dict[int, int] = {}  # row -> ticks since reset
-        self.fs_free: list[int] = list(range(self.fs_capacity))
+        self.fs_row_pool: dict[int, object] = {}  # row -> pool (live)
+        self.fs_free: list[int] = list(range(self.fs_capacity))  # heap
         self.fs_pending_reset: set[int] = set()
+
+        # Event-maintained signal columns (see module docstring). The
+        # dirty set is SHARED with every handle this sampler hands out;
+        # sample_once drains it in place (never rebinds it).
+        self.fs_dirty: set[int] = set()
+        self.fs_polled: set[int] = set()   # rows re-gathered every tick
+        self.fs_handles: dict[int, TelemetryRowHandle] = {}
+        self.fs_tick_visits = 0       # rows re-gathered last tick
+        self.fs_gather_visits = 0     # cumulative, for regression tests
+        self._alloc_columns(self.fs_capacity)
+
+        # Roster generation last reconciled (monitors without the
+        # counter reconcile every tick).
+        self.fs_monitor_gen: object = object()
+
         self.fs_state = None                   # FleetState (lazy)
         self.fs_latest: dict | None = None
         self.fs_history: list[dict] = []
@@ -135,6 +265,17 @@ class FleetSampler:
 
     # -- row management --------------------------------------------------
 
+    def _alloc_columns(self, cap: int) -> None:
+        import numpy as np
+        self.fs_cols = {
+            name: np.full((cap,), default, np.float32)
+            for name, default in _COL_DEFAULTS.items()}
+        # Head-of-queue enqueue instant, absolute ms (0 = no waiter).
+        # float64: absolute wall-clock ms do not fit f32; the sojourn
+        # subtraction happens in f64 and only the result narrows.
+        self.fs_head_ts = np.zeros((cap,), np.float64)
+        self.fs_active = np.zeros((cap,), bool)
+
     def _ensure_state(self):
         from .telemetry import (_step_shardings, fleet_init,
                                 make_live_step, shard_state)
@@ -153,6 +294,7 @@ class FleetSampler:
 
     def _grow(self, need: int) -> None:
         import jax.numpy as jnp
+        import numpy as np
         from ..ops.codel_batch import CodelState
         from .telemetry import FleetState, shard_state
         old = self.fs_capacity
@@ -173,38 +315,86 @@ class FleetSampler:
             self.fs_state = shard_state(
                 self.fs_state, self.fs_mesh, self.fs_mesh_axes)
         self.fs_input_cache.clear()   # shapes changed
+        for name, arr in self.fs_cols.items():
+            grown = np.full((cap,), _COL_DEFAULTS[name], np.float32)
+            grown[:old] = arr
+            self.fs_cols[name] = grown
+        head = np.zeros((cap,), np.float64)
+        head[:old] = self.fs_head_ts
+        self.fs_head_ts = head
+        active = np.zeros((cap,), bool)
+        active[:old] = self.fs_active
+        self.fs_active = active
         self.fs_free.extend(range(old, cap))
+        heapq.heapify(self.fs_free)
         self.fs_capacity = cap
 
-    def _assign_rows(self, pools: dict[str, object]) -> None:
+    def _attach_row(self, pool, row: int) -> None:
+        handle = TelemetryRowHandle(row, self.fs_dirty)
+        self.fs_handles[row] = handle
+        self.fs_row_pool[row] = pool
+        attach = getattr(pool, 'telemetry_attach', None)
+        if attach is not None:
+            attach(handle)
+            self.fs_dirty.add(row)     # first gather
+        else:
+            # No push support (e.g. a bare test double): this row is
+            # re-gathered every tick, exactly the old full-walk cost
+            # but only for the pools that need it.
+            self.fs_polled.add(row)
+
+    def _release_row(self, row: int) -> None:
+        pool = self.fs_row_pool.pop(row, None)
+        handle = self.fs_handles.pop(row, None)
+        if handle is not None:
+            handle.detach()
+            detach = getattr(pool, 'telemetry_detach', None)
+            if detach is not None:
+                detach(handle)
+        self.fs_polled.discard(row)
+        self.fs_dirty.discard(row)
+        for name, arr in self.fs_cols.items():
+            arr[row] = _COL_DEFAULTS[name]
+        self.fs_head_ts[row] = 0.0
+        self.fs_active[row] = False
+
+    def _assign_rows(self, pools: Mapping) -> None:
         for uuid in [u for u in self.fs_rows if u not in pools]:
             row = self.fs_rows.pop(uuid)
-            self.fs_free.append(row)
+            self._release_row(row)
+            heapq.heappush(self.fs_free, row)
         fresh = [u for u in pools if u not in self.fs_rows]
         if len(self.fs_rows) + len(fresh) > self.fs_capacity:
             self._grow(len(self.fs_rows) + len(fresh))
         for uuid in fresh:
-            row = self.fs_free.pop(0)
+            row = heapq.heappop(self.fs_free)
             self.fs_rows[uuid] = row
             self.fs_pending_reset.add(row)
             self.fs_row_ticks[row] = 0
+            self.fs_active[row] = True
+            self._attach_row(pools[uuid], row)
 
     # -- gathering -------------------------------------------------------
 
     @staticmethod
-    def gather_pool(pool, now: float) -> dict:
-        """One pool's tick signals, using the pools' own formulas.
+    def gather_pool_signals(pool) -> dict:
+        """One pool's time-independent tick signals, using the pools'
+        own formulas — the single source of truth for both the
+        incremental columns (dirty-row patching) and the
+        :meth:`gather_pool` oracle.
 
         sample: identical to ConnectionPool._lp_sample (busy + spares
-        option). sojourn: first still-waiting claim's queue time.
+        option). head_ts: the first still-waiting claim's enqueue
+        instant (absolute ms; 0.0 = empty queue) — the tick turns it
+        into a sojourn with one vectorized ``now - head_ts``.
         retry_*: the deepest backoff slot's ladder position, from which
         the batched law reproduces its current sm_delay."""
         sample = pool.lp_load_sample()
 
-        sojourn = 0.0
+        head_ts = 0.0
         for hdl in pool.p_waiters:
             if hdl.is_in_state('waiting'):
-                sojourn = now - hdl.ch_started
+                head_ts = float(hdl.ch_started)
                 break
 
         target_delay = math.inf
@@ -229,13 +419,58 @@ class FleetSampler:
                     delay0 = float(smgr.sm_min_delay)
                     max_delay = float(smgr.sm_max_delay)
         return {
-            'sample': float(sample), 'sojourn': float(sojourn),
+            'sample': float(sample), 'head_ts': head_ts,
             'target_delay': target_delay,
             'spares': float(pool.p_spares),
             'maximum': float(pool.p_max),
             'retry_delay': delay0, 'retry_max_delay': max_delay,
             'retry_attempt': attempt, 'n_retrying': float(n_retrying),
         }
+
+    @staticmethod
+    def gather_pool(pool, now: float) -> dict:
+        """The oracle: one pool's tick signals gathered fresh, sojourn
+        included. The incremental columns must agree with this
+        element-for-element at every tick (tests assert it under
+        churn); it is also what the polled fallback and the dirty-row
+        patching build on, via :meth:`gather_pool_signals`."""
+        g = FleetSampler.gather_pool_signals(pool)
+        head_ts = g.pop('head_ts')
+        g['sojourn'] = float(now - head_ts) if head_ts else 0.0
+        # Present sample first, sojourn second: the published inputs
+        # dict keeps its historical key order.
+        return {'sample': g.pop('sample'), 'sojourn': g.pop('sojourn'),
+                **g}
+
+    def _patch_dirty_rows(self) -> None:
+        """Re-read signals for every row whose pool reported an event
+        since the last tick (plus the polled fallback rows). This is
+        the O(changed) heart of the incremental gather."""
+        patch = self.fs_dirty
+        patch.update(self.fs_polled)
+        self.fs_tick_visits = len(patch)
+        self.fs_gather_visits += len(patch)
+        if not patch:
+            return
+        cols = self.fs_cols
+        head = self.fs_head_ts
+        row_pool = self.fs_row_pool
+        for row in patch:
+            pool = row_pool.get(row)
+            if pool is None:
+                continue   # freed after the mark; row already reset
+            g = self.gather_pool_signals(pool)
+            head[row] = g['head_ts']
+            cols['samples'][row] = g['sample']
+            cols['target_delay'][row] = g['target_delay']
+            cols['spares'][row] = g['spares']
+            cols['maximum'][row] = g['maximum']
+            cols['retry_delay'][row] = g['retry_delay']
+            cols['retry_max_delay'][row] = g['retry_max_delay']
+            cols['retry_attempt'][row] = g['retry_attempt']
+            cols['n_retrying'][row] = g['n_retrying']
+        # In-place clear: the handles hold this very set object.
+        patch.clear()
 
     def _place_inputs(self, arrays: dict, now: float):
         """Host tick columns -> device FleetInputs, re-shipping only
@@ -246,7 +481,9 @@ class FleetSampler:
         a tunneled chip every avoided host->device transfer is an RTT
         saved, so unchanged columns reuse their committed device array
         from the last tick. The scalar clock always changes and always
-        ships."""
+        ships. Callers pass per-tick copies, never the live columns —
+        the cache keeps the host array it committed, and comparing a
+        live column against itself would always read "unchanged"."""
         import jax
         import numpy as np
         from .telemetry import FleetInputs
@@ -266,12 +503,16 @@ class FleetSampler:
         return FleetInputs(now_ms=np.float32(now), **placed)
 
     def sample_once(self) -> dict | None:
-        """One synchronous tick: gather, step, publish. Returns the
-        published record (None when sampling is impossible)."""
+        """One synchronous tick: patch dirty rows, step, publish.
+        Returns the published record (None when sampling is
+        impossible)."""
         import numpy as np
 
-        pools = dict(self.fs_monitor.pm_pools)
-        self._assign_rows(pools)
+        monitor = self.fs_monitor
+        gen = getattr(monitor, 'pm_generation', None)
+        if gen is None or gen != self.fs_monitor_gen:
+            self._assign_rows(monitor.pm_pools)
+            self.fs_monitor_gen = gen
         abs_now = mod_utils.current_millis()
         now = abs_now - self.fs_epoch
         if now > EPOCH_LIMIT:
@@ -282,36 +523,30 @@ class FleetSampler:
             now -= shift
         cap = self.fs_capacity
 
-        f32 = lambda: np.zeros((cap,), np.float32)  # noqa: E731
-        cols = {k: f32() for k in (
-            'samples', 'sojourns', 'spares', 'maximum', 'retry_delay',
-            'retry_max_delay', 'retry_attempt', 'n_retrying')}
-        cols['target_delay'] = np.full((cap,), np.inf, np.float32)
-        active = np.zeros((cap,), bool)
+        self._patch_dirty_rows()
+
+        # The always-moving piece, vectorized over the column: the
+        # head-of-queue sojourn every occupied row with a waiter sees
+        # this instant.
+        head = self.fs_head_ts
+        sojourns = np.where(
+            head > 0.0, abs_now - head, 0.0).astype(np.float32)
+
         reset = np.zeros((cap,), bool)
         for row in self.fs_pending_reset:
             reset[row] = True
         self.fs_pending_reset.clear()
 
-        gathered = {}
-        for uuid, pool in pools.items():
-            row = self.fs_rows[uuid]
-            g = self.gather_pool(pool, abs_now)
-            gathered[uuid] = (row, g)
-            active[row] = True
-            cols['samples'][row] = g['sample']
-            cols['sojourns'][row] = g['sojourn']
-            cols['target_delay'][row] = g['target_delay']
-            cols['spares'][row] = g['spares']
-            cols['maximum'][row] = g['maximum']
-            cols['retry_delay'][row] = g['retry_delay']
-            cols['retry_max_delay'][row] = g['retry_max_delay']
-            cols['retry_attempt'][row] = g['retry_attempt']
-            cols['n_retrying'][row] = g['n_retrying']
+        # Per-tick snapshots: the transfer cache commits these (and
+        # compares against them next tick), and the published record
+        # keeps reading them after the live columns move on.
+        arrays = {name: col.copy() for name, col in self.fs_cols.items()}
+        arrays['sojourns'] = sojourns
+        arrays['active'] = self.fs_active.copy()
+        arrays['reset'] = reset
 
         state = self._ensure_state()
-        inp = self._place_inputs(
-            dict(active=active, reset=reset, **cols), now)
+        inp = self._place_inputs(arrays, now)
         try:
             new_state, out, fleet = self.fs_step(state, inp)
         except Exception:
@@ -333,23 +568,7 @@ class FleetSampler:
 
         fleet_np = {k: float(v) for k, v in fleet.items()}
         out_np = {k: np.asarray(v) for k, v in out.items()}
-        per_pool = {}
-        for uuid, (row, g) in gathered.items():
-            # target_delay=inf means "CoDel off" in the arrays; publish
-            # None instead (Infinity is not valid JSON and the kang
-            # surface is read by strict external parsers).
-            pub = dict(g)
-            if not math.isfinite(pub['target_delay']):
-                pub['target_delay'] = None
-            per_pool[uuid] = {
-                'row': row,
-                'inputs': pub,
-                'filtered': float(out_np['filtered'][row]),
-                'target': float(out_np['target'][row]),
-                'clamped': bool(out_np['clamped'][row]),
-                'drop': bool(out_np['drop'][row]),
-                'retry_backoff': float(out_np['retry_backoff'][row]),
-            }
+        per_pool = _TickPools(dict(self.fs_rows), arrays, out_np)
         if self.fs_actuate:
             # Close the loop: hand each pool its batched decision.
             # The pool stores it unconditionally but consults it only
@@ -361,18 +580,22 @@ class FleetSampler:
             # sampler restart. Only a fully-populated window (which by
             # the parity laws equals the per-pool filter fed the same
             # samples) is advisory-grade.
-            for uuid, (row, g) in gathered.items():
+            for row, pool in self.fs_row_pool.items():
                 ticks = self.fs_row_ticks.get(row, 0) + 1
                 self.fs_row_ticks[row] = ticks
                 if ticks < self.fs_taps:
                     continue
-                receive = getattr(pools[uuid],
-                                  'receive_fleet_advisory', None)
+                receive = getattr(pool, 'receive_fleet_advisory', None)
                 if receive is not None:
                     receive(float(out_np['filtered'][row]), abs_now)
 
         record = {'tick': self.fs_ticks, 'now_ms': now,
                   'fleet': fleet_np, 'pools': per_pool}
+        if self.fs_record:
+            # History must be plain data — a lazy view per retained
+            # tick would pin every tick's column copies anyway, and
+            # tests diff whole records.
+            record['pools'] = dict(per_pool)
         self.fs_latest = record
         if self.fs_record:
             self.fs_history.append(record)
@@ -394,6 +617,12 @@ class FleetSampler:
                     self.fs_mesh.devices.shape)},
                 'n_devices': int(self.fs_mesh.size),
             }
+        latest = self.fs_latest
+        if latest is not None and not isinstance(latest['pools'], dict):
+            # Materialize the lazy per-pool view for the JSON surface
+            # (http_server serializes unknown mappings as repr).
+            latest = dict(latest)
+            latest['pools'] = dict(latest['pools'])
         return {
             'interval_ms': self.fs_interval,
             'capacity': self.fs_capacity,
@@ -402,5 +631,6 @@ class FleetSampler:
             'actuate': self.fs_actuate,
             'mesh': mesh,
             'row_ticks': dict(self.fs_row_ticks),
-            'latest': self.fs_latest,
+            'last_tick_visits': self.fs_tick_visits,
+            'latest': latest,
         }

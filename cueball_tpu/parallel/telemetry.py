@@ -86,13 +86,13 @@ def fleet_inputs(n_pools: int, **kw) -> FleetInputs:
 
 
 def _default_fir():
-    """FIR implementation for this backend: the pallas kernel on TPU
-    (last measured 1.29x the XLA einsum on v5 lite — 19.4M vs 15.0M
-    pools/s, round-4 BENCH_TPU.json capture; bench.py re-measures both
-    paths every run and tools/chip_bench.py re-captures the artifact
-    with a code hash, so this default stays evidence-based), the XLA
-    einsum elsewhere (pallas would only run in interpret mode
-    off-TPU)."""
+    """FIR implementation for this backend: the pallas kernel on TPU,
+    the XLA einsum elsewhere (pallas would only run in interpret mode
+    off-TPU). The on-TPU preference rests on a round-4 capture
+    (archived BENCH_TPU_r04.json, 1.29x the einsum on v5 lite) that
+    predates the code-hash guard — unverified against the current
+    measured path until tools/chip_bench.py re-captures with a hash;
+    bench.py re-measures both paths on every chip run."""
     return fir_apply_pallas if jax.default_backend() == 'tpu' \
         else fir_apply
 

@@ -264,6 +264,11 @@ class ConnectionPool(FSM):
         self.p_fleet_actuation = bool(options.get('fleetActuation'))
         self.p_fleet_advisory: tuple[float, float] | None = None
 
+        # Fleet-telemetry push handles (see FleetSampler): a tuple so
+        # the per-event dirty mark is a plain iteration — empty for the
+        # (default) unsampled pool, one entry per attached sampler.
+        self.p_telemetry: tuple = ()
+
         # Low-pass filter sampling at 5 Hz
         # (reference lib/pool.js:249-262).
         self.p_lp_emitter = EventEmitter()
@@ -297,6 +302,48 @@ class ConnectionPool(FSM):
         self.p_fleet_advisory = (
             float(filtered),
             at_ms if at_ms is not None else mod_utils.current_millis())
+
+    # -- fleet telemetry push protocol -----------------------------------
+
+    def telemetry_attach(self, handle) -> None:
+        """Accept a FleetSampler row handle. From here on the pool
+        (and its slots/claims) call handle.mark_dirty() at every event
+        that can move a gathered signal, so the sampler re-reads this
+        pool only on ticks where something actually changed."""
+        self.p_telemetry = self.p_telemetry + (handle,)
+
+    def telemetry_detach(self, handle) -> None:
+        self.p_telemetry = tuple(
+            h for h in self.p_telemetry if h is not handle)
+
+    def _telemetry_dirty(self) -> None:
+        """O(1) per attached sampler: flag this pool's telemetry row
+        stale. Cheap enough for the claim hot path (a no-op tuple walk
+        when no sampler is attached)."""
+        for h in self.p_telemetry:
+            h.mark_dirty()
+
+    def set_spares(self, spares: int) -> None:
+        """Reconfigure the spares target at runtime (and tell any
+        attached fleet sampler the row moved)."""
+        if not isinstance(spares, int):
+            raise AssertionError('spares must be a number')
+        self.p_spares = spares
+        self._telemetry_dirty()
+        self.rebalance()
+
+    setSpares = set_spares
+
+    def set_maximum(self, maximum: int) -> None:
+        """Reconfigure the connection cap at runtime (and tell any
+        attached fleet sampler the row moved)."""
+        if not isinstance(maximum, int):
+            raise AssertionError('maximum must be a number')
+        self.p_max = maximum
+        self._telemetry_dirty()
+        self.rebalance()
+
+    setMaximum = set_maximum
 
     def _shrink_floor(self) -> float:
         """The low-pass load figure the shrink clamp uses: the fleet
@@ -819,6 +866,10 @@ class ConnectionPool(FSM):
         fsm.p_idleq_node = None
 
         def on_changed(new_state):
+            # Every slot transition can move the busy count (and so
+            # the gathered load sample); one dirty mark covers all the
+            # branches below.
+            self._telemetry_dirty()
             if fsm.p_initq_node:
                 # Still starting up during these transitions.
                 if new_state in ('init', 'connecting', 'retrying'):
@@ -916,6 +967,9 @@ class ConnectionPool(FSM):
 
         fsm.on('stateChanged', on_changed)
         fsm.start()
+        # The initq push above changed the load sample immediately;
+        # the slot's first stateChanged only lands next loop turn.
+        self._telemetry_dirty()
 
     addConnection = add_connection
 
@@ -1028,6 +1082,9 @@ class ConnectionPool(FSM):
                 fsm.p_idleq_node = None
                 if not fsm.is_in_state('idle'):
                     continue
+                # The idleq shift moved the busy count NOW; the slot's
+                # 'busy' stateChanged only lands next loop turn.
+                self._telemetry_dirty()
                 handle.try_(fsm)
                 return
 
@@ -1037,6 +1094,7 @@ class ConnectionPool(FSM):
                 return
 
             handle.ch_waiter_node = self.p_waiters.push(handle)
+            self._telemetry_dirty()   # a head sojourn may now exist
             handle.arm_claim_timer()
             self._hwm_counter('max-claim-queue', len(self.p_waiters))
             self._incr_counter('queued-claim')

@@ -88,14 +88,78 @@ def test_committed_artifact_if_present_is_not_stale():
     """If the repo ships a BENCH_TPU.json, its recorded hash must
     match the current measured-path code — otherwise the capture was
     forgotten after a kernel/laws change and the citation path would
-    refuse it at bench time."""
+    refuse it at bench time. A pre-guard artifact (no hash at all)
+    FAILS this gate rather than passing vacuously: hashless captures
+    must be archived under another name (e.g. BENCH_TPU_r04.json),
+    not shipped where the citation path looks."""
     root = os.path.dirname(os.path.abspath(bench.__file__))
     path = os.path.join(root, 'BENCH_TPU.json')
     if not os.path.exists(path):
         return
     with open(path, encoding='utf-8') as f:
         art = json.load(f)
-    if 'code_hash' not in art:
-        return   # pre-guard artifact; superseded by the next capture
+    assert 'code_hash' in art, (
+        'BENCH_TPU.json predates the code-hash guard: its numbers are '
+        'unverifiable. Archive it (BENCH_TPU_rNN.json) and re-capture '
+        'with tools/chip_bench.py')
     assert art['code_hash'] == bench.telemetry_code_hash(), (
         'BENCH_TPU.json is stale: re-run tools/chip_bench.py')
+
+
+def test_host_stages_land_without_chip():
+    """The assembly invariant behind `make bench-host`: the host-path
+    sampler tick numbers must land in the result even when the chip
+    stage errored (or never ran) — a dead tunnel must not blank the
+    host columns of the JSON line."""
+    host_tick = bench.bench_sampler_tick_host(sizes=(64,))
+    assert host_tick['tick_us_64'] > 0
+    claim = (100.0, 1.0, [100.0], [{}])
+    queued = (50.0, 1.0)
+    telem = {'error': 'chip tunnel down', 'stages_completed': []}
+    result = bench.assemble_result(1.0, claim, queued, host_tick, telem)
+    assert result['sampler_tick_host_us']['64'] > 0
+    assert result['sampler_gather_host_us']['64'] > 0
+    assert result['telemetry_error'] == 'chip tunnel down'
+    # No live chip number -> the citation path runs; with only the
+    # archived pre-guard artifact in-tree it must add nothing (no
+    # silent resurrection of unverified numbers).
+    assert 'telemetry_committed_artifact' not in result
+
+
+def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
+    """bench.py --host-only must emit the one JSON line with every
+    host field populated while never touching the chip subprocess."""
+    import asyncio
+
+    async def fake_codel():
+        return 2.5
+
+    async def fake_claim():
+        return (100.0, 1.0, [100.0], [{}])
+
+    async def fake_queued():
+        return (50.0, 1.0)
+
+    def boom(*a, **kw):
+        raise AssertionError('chip stage must not run under host_only')
+
+    monkeypatch.setattr(bench, 'bench_codel_tracking', fake_codel)
+    monkeypatch.setattr(bench, 'bench_claim_throughput', fake_claim)
+    monkeypatch.setattr(bench, 'bench_queued_claim_throughput',
+                        fake_queued)
+    monkeypatch.setattr(bench, 'bench_sampler_tick_host',
+                        lambda: {'tick_us_64': 10.0, 'gather_us_64': 5.0})
+    monkeypatch.setattr(bench, 'bench_telemetry_step_guarded', boom)
+    # Don't pin the pytest process to one core for the rest of the run.
+    monkeypatch.setattr(bench.os, 'sched_setaffinity',
+                        lambda *a: None, raising=False)
+
+    asyncio.run(bench.main(host_only=True))
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result['host_only'] is True
+    assert result['value'] == 2.5
+    assert result['claim_release_ops_per_sec'] == 100.0
+    assert result['sampler_tick_host_us'] == {'64': 10.0}
+    assert result['telemetry_pools_per_sec'] is None
+    assert 'telemetry_error' not in result

@@ -519,3 +519,261 @@ def test_actuation_warmup_gate_then_reproduces_python_decisions():
             for pool in pools:
                 pool.stop()
     run_async(t())
+
+
+# ---------------------------------------------------------------------------
+# Incremental gather: the event-maintained signal columns. The pools
+# below speak the push protocol (telemetry_attach + mark_dirty on
+# every signal-moving event) exactly like a real ConnectionPool, so
+# the sampler never polls them — a tick re-gathers only dirty rows.
+
+class PushWaiter:
+    def __init__(self, started):
+        self.ch_started = started
+
+    def is_in_state(self, st):
+        return st == 'waiting'
+
+
+class PushCodel:
+    def __init__(self, target):
+        self.cd_targdelay = target
+
+
+class PushSmgr:
+    def __init__(self, retries, left, min_delay, max_delay):
+        self.sm_retries = retries
+        self.sm_retries_left = left
+        self.sm_min_delay = min_delay
+        self.sm_max_delay = max_delay
+
+    def is_in_state(self, st):
+        return st == 'backoff'
+
+
+class PushSlot:
+    def __init__(self, smgr):
+        self.ps_smgr = smgr
+
+    def get_socket_mgr(self):
+        return self.ps_smgr
+
+
+class PushPool:
+    """The minimal gather_pool surface PLUS the push protocol: every
+    mutator marks the attached rows dirty, the way the real pool's
+    event hooks do. Used by the O(dirty) and churn-agreement tests
+    (and the mesh-path ones in test_sampler_mesh.py)."""
+
+    _seq = 0
+
+    def __init__(self, load=0.0):
+        PushPool._seq += 1
+        self.p_uuid = 'push-%d' % PushPool._seq
+        self.p_spares = 2.0
+        self.p_max = 8.0
+        self.p_codel = None
+        self.p_waiters = []
+        self.p_connections = {}
+        self.p_telemetry = ()
+        self._load = load
+
+    def lp_load_sample(self):
+        return self._load
+
+    def telemetry_attach(self, handle):
+        self.p_telemetry = self.p_telemetry + (handle,)
+
+    def telemetry_detach(self, handle):
+        self.p_telemetry = tuple(
+            h for h in self.p_telemetry if h is not handle)
+
+    def _telemetry_dirty(self):
+        for h in self.p_telemetry:
+            h.mark_dirty()
+
+    def set_load(self, load):
+        self._load = load
+        self._telemetry_dirty()
+
+    def set_spares(self, spares):
+        self.p_spares = spares
+        self._telemetry_dirty()
+
+    def set_waiters(self, waiters):
+        self.p_waiters = list(waiters)
+        self._telemetry_dirty()
+
+    def set_backoff(self, smgrs):
+        self.p_connections = (
+            {'b0': [PushSlot(s) for s in smgrs]} if smgrs else {})
+        self._telemetry_dirty()
+
+
+# Column name -> gather_pool_signals key, for oracle comparisons.
+_COL_KEYS = {
+    'samples': 'sample', 'target_delay': 'target_delay',
+    'spares': 'spares', 'maximum': 'maximum',
+    'retry_delay': 'retry_delay', 'retry_max_delay': 'retry_max_delay',
+    'retry_attempt': 'retry_attempt', 'n_retrying': 'n_retrying',
+}
+
+
+def assert_columns_match_oracle(sampler, pool):
+    """Element-for-element: the row's event-maintained columns equal a
+    fresh full gather of the pool (the incremental/oracle contract)."""
+    row = sampler.fs_rows[pool.p_uuid]
+    g = FleetSampler.gather_pool_signals(pool)
+    assert sampler.fs_head_ts[row] == g['head_ts'], pool.p_uuid
+    for col, key in _COL_KEYS.items():
+        assert sampler.fs_cols[col][row] == np.float32(g[key]), (
+            pool.p_uuid, col)
+
+
+def test_idle_fleet_tick_visits_o_dirty_not_o_fleet():
+    """The perf contract behind the incremental gather: over an idle
+    1k-pool fleet a tick re-gathers ZERO rows; moving 10 pools costs
+    10 visits, not 1000."""
+    mon = PoolMonitor()
+    fleet = [PushPool(load=float(i % 5)) for i in range(1000)]
+    for p in fleet:
+        mon.register_pool(p)
+    s = FleetSampler({'monitor': mon})
+
+    s.sample_once()
+    assert s.fs_tick_visits == 1000   # first tick gathers everything
+    assert not s.fs_polled            # push pools are never polled
+
+    base = s.fs_gather_visits
+    for _ in range(5):
+        s.sample_once()
+        assert s.fs_tick_visits == 0  # idle fleet: no rows re-read
+    assert s.fs_gather_visits == base
+
+    for p in fleet[::100]:            # 10 pools move...
+        p.set_load(p._load + 1.0)
+        p.set_load(p._load + 1.0)     # ...twice each: events dedupe
+    s.sample_once()
+    assert s.fs_tick_visits == 10
+    assert s.fs_gather_visits == base + 10
+    for p in fleet[::100]:            # and the re-read is fresh
+        assert_columns_match_oracle(s, p)
+
+
+def test_push_churn_columns_agree_with_oracle():
+    """Seeded churn over push-protocol pools — arrivals/departures
+    (rows freed and reassigned), loads, spares, CoDel targets, live
+    waiters, backoff ladders — re-checking after every tick that each
+    occupied row's columns equal a fresh full gather, and that freed
+    rows reset to defaults."""
+    from cueball_tpu import utils as mod_utils
+    from cueball_tpu.parallel.sampler import _COL_DEFAULTS
+
+    rng = np.random.default_rng(7)
+    mon = PoolMonitor()
+    s = FleetSampler({'monitor': mon})
+    fleet = []
+
+    def spawn():
+        p = PushPool(load=float(rng.uniform(0, 8)))
+        if rng.uniform() < 0.5:
+            p.p_codel = PushCodel(float(rng.choice([300.0, 1000.0])))
+        fleet.append(p)
+        mon.register_pool(p)
+
+    for _ in range(6):
+        spawn()
+    freed_rows = []
+    for tick in range(60):
+        if rng.uniform() < 0.25 and len(fleet) < 24:
+            spawn()
+        if rng.uniform() < 0.15 and len(fleet) > 2:
+            gone = fleet.pop(int(rng.integers(len(fleet))))
+            freed_rows.append(s.fs_rows[gone.p_uuid])
+            mon.unregister_pool(gone)
+        for p in fleet:
+            if rng.uniform() < 0.4:
+                p.set_load(float(rng.uniform(0, 8)))
+            if rng.uniform() < 0.15:
+                p.set_spares(float(rng.integers(0, 5)))
+            if p.p_codel is not None and rng.uniform() < 0.5:
+                now = mod_utils.current_millis()
+                p.set_waiters(
+                    [PushWaiter(now - float(rng.uniform(0, 1500)))]
+                    if rng.uniform() < 0.6 else [])
+            if rng.uniform() < 0.2:
+                p.set_backoff([PushSmgr(5, int(rng.integers(1, 5)),
+                                        100.0, 10000.0)]
+                              if rng.uniform() < 0.7 else [])
+        s.sample_once()
+        for p in fleet:
+            assert_columns_match_oracle(s, p)
+        # Freed rows that are not (yet) reassigned sit inactive at
+        # the column defaults — no stale signals leak into the step.
+        occupied = set(s.fs_rows.values())
+        for row in freed_rows:
+            if row in occupied:
+                continue
+            assert not s.fs_active[row], tick
+            assert s.fs_head_ts[row] == 0.0, tick
+            for name, default in _COL_DEFAULTS.items():
+                got = float(s.fs_cols[name][row])
+                assert got == np.float32(default), (tick, name)
+    assert freed_rows                  # churn actually recycled rows
+    assert not s.fs_polled
+
+
+def test_real_pool_event_hooks_keep_columns_fresh():
+    """The live half of the contract: a REAL ConnectionPool under
+    claim/release/queue churn must mark its row dirty at every
+    signal-moving event — after each tick the row's columns must
+    equal a fresh oracle gather. A missed hook (a stale column) fails
+    here even though the parity suite would replay the stale value
+    consistently."""
+    async def t():
+        ctx = Ctx()
+        pool, inner = make_pool(ctx, spares=2, maximum=2,
+                                targetClaimDelay=300)
+        inner.emit('added', 'a1', {})
+        await settle()
+        for c in list(ctx.connections):
+            c.connect()
+        await settle()
+
+        sampler = make_sampler([pool])
+        held = []
+        queued = []
+        try:
+            for _ in range(2):
+                fut, _ = claim(pool)
+                held.append(await fut)
+            queued.extend(claim(pool) for _ in range(3))
+
+            for tick in range(25):
+                await asyncio.sleep(0.01)
+                sampler.sample_once()
+                assert not sampler.fs_polled   # real pools push
+                assert_columns_match_oracle(sampler, pool)
+                # Keep the queue/busy set moving: release a held
+                # claim (a queued waiter is handed the conn), then
+                # re-claim later.
+                if tick % 6 == 2 and held:
+                    hdl, _ = held.pop()
+                    hdl.release()
+                if tick % 6 == 5:
+                    queued.append(claim(pool))
+                for item in list(queued):
+                    if item[0].done():
+                        held.append(await item[0])
+                        queued.remove(item)
+        finally:
+            for fut, waiter in queued:
+                if fut.done():
+                    (await fut)[0].release()
+                else:
+                    waiter.cancel()
+            for hdl, _ in held:
+                hdl.release()
+            pool.stop()
+        await settle(30)
+    run_async(t())

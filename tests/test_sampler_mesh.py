@@ -450,3 +450,66 @@ def test_input_cache_reships_only_changed_columns():
     s.sample_once()
     assert s.fs_input_cache['samples'][1] is not samples0
     assert s.fs_input_cache['maximum'][1] is kept
+
+
+def test_mesh_push_churn_columns_agree_with_oracle(frozen_clock):
+    """The incremental-gather contract on the MESH path, with TWO
+    samplers push-attached to every pool (each pool carries two
+    handles; each event marks both dirty): under seeded churn with
+    rows freed and reassigned, both samplers' columns must equal a
+    fresh oracle gather after every tick, and their published
+    decisions must match each other."""
+    from test_sampler import (PushCodel, PushPool, PushSmgr, PushWaiter,
+                              assert_columns_match_oracle)
+
+    rng = np.random.default_rng(11)
+    mon = PoolMonitor()
+    meshed = FleetSampler({'monitor': mon, 'mesh': pools_mesh()})
+    plain = FleetSampler({'monitor': mon})
+    fleet = []
+
+    def spawn():
+        p = PushPool(load=float(rng.uniform(0, 8)))
+        if rng.uniform() < 0.4:
+            p.p_codel = PushCodel(float(rng.choice([300.0, 1000.0])))
+        fleet.append(p)
+        mon.register_pool(p)
+
+    for _ in range(5):
+        spawn()
+    recycled = 0
+    for tick in range(80):
+        frozen_clock.advance(100)
+        if rng.uniform() < 0.2 and len(fleet) < 24:
+            spawn()
+        if rng.uniform() < 0.1 and len(fleet) > 2:
+            gone = fleet.pop(int(rng.integers(len(fleet))))
+            mon.unregister_pool(gone)
+            recycled += 1
+        for p in fleet:
+            if rng.uniform() < 0.35:
+                p.set_load(float(rng.uniform(0, 8)))
+            if p.p_codel is not None and rng.uniform() < 0.5:
+                p.set_waiters(
+                    [PushWaiter(
+                        frozen_clock() - float(rng.uniform(0, 1500)))]
+                    if rng.uniform() < 0.6 else [])
+            if rng.uniform() < 0.15:
+                p.set_backoff(
+                    [PushSmgr(5, int(rng.integers(1, 5)),
+                              100.0, 10000.0)]
+                    if rng.uniform() < 0.7 else [])
+        rec_m = meshed.sample_once()
+        rec_p = plain.sample_once()
+        for p in fleet:
+            assert len(p.p_telemetry) == 2, tick
+            assert_columns_match_oracle(meshed, p)
+            assert_columns_match_oracle(plain, p)
+        for uuid, got in rec_m['pools'].items():
+            want = rec_p['pools'][uuid]
+            assert got['inputs'] == want['inputs'], (tick, uuid)
+            for key in ('filtered', 'target', 'retry_backoff'):
+                assert got[key] == pytest.approx(
+                    want[key], rel=1e-5, abs=1e-5), (tick, uuid, key)
+    assert recycled > 0
+    assert not meshed.fs_polled and not plain.fs_polled
