@@ -5,7 +5,7 @@ LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
 .PHONY: test check bench bench-host dryrun coverage native ci docs \
-	docs-check
+	docs-check fsm-graph
 
 native:
 	$(PYTHON) native/build.py
@@ -14,15 +14,24 @@ test: native
 	$(PYTHON) -m pytest tests/ -x -q
 
 # The reference gates check on jsl + jsstyle (reference Makefile:33-41);
-# cblint is the vendored equivalent (tools/cblint.py) and FAILS the
-# build on any violation.
+# cblint is the vendored equivalent (tools/cblint.py) and cbfsm the
+# Moore-FSM analyzer (tools/cbfsm.py, docs/fsm-analysis.md); both FAIL
+# the build on any violation.
 check:
 	$(PYTHON) -m compileall -q cueball_tpu bin/cbresolve bench.py __graft_entry__.py
 	$(PYTHON) tools/cblint.py $(LINT_TARGETS)
+	$(PYTHON) tools/cbfsm.py cueball_tpu
+
+# Regenerate the committed FSM transition diagrams (docs/fsm/).
+fsm-graph:
+	$(PYTHON) tools/cbfsm.py --graphs docs/fsm cueball_tpu
 
 # The full CI gate, runnable locally: build from source, lint, test on
-# both cores, dryrun the multichip sharding path.
+# both cores, dryrun the multichip sharding path. The --check-graphs
+# step is the stale-diagram gate: ci fails when docs/fsm/ differs from
+# what `make fsm-graph` would write.
 ci: native check docs-check
+	$(PYTHON) tools/cbfsm.py --check-graphs docs/fsm cueball_tpu
 	$(PYTHON) -m pytest tests/ -x -q
 	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -x -q
 	$(MAKE) dryrun

@@ -578,8 +578,10 @@ class CueBallClaimHandle(FSM):
             # Re-entry after a rejected claim: ask the pool to try
             # again next tick (the initial entry runs during __init__,
             # before the pool has installed ch_requeue — the pool
-            # schedules that first try itself).
-            get_loop().call_soon(self.ch_requeue)
+            # schedules that first try itself).  Deliberately NOT
+            # S.immediate: the requeue must survive leaving 'waiting'
+            # (a claim can be handed out before the tick fires).
+            get_loop().call_soon(self.ch_requeue)  # cbfsm: ignore=F006
 
         S.goto_state_on(self, 'tryAsserted', 'claiming')
 
@@ -771,6 +773,7 @@ class ConnectionSlotFSM(FSM):
     # -- states ----------------------------------------------------------
 
     def state_init(self, S):
+        S.validTransitions(['connecting'])
         S.goto_state_on(self, 'startAsserted', 'connecting')
 
     def state_connecting(self, S):

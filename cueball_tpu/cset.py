@@ -268,7 +268,9 @@ class ConnectionSet(FSM):
                     if lconn is not None and \
                             not lconn.is_in_state('stopped'):
                         lconn.drain()
-                get_loop().call_soon(drain_one)
+                # Deliberately NOT S.immediate: the drain must still run
+                # if the set reaches 'stopped' before the tick fires.
+                get_loop().call_soon(drain_one)  # cbfsm: ignore=F006
 
     def state_stopped(self, S):
         S.validTransitions([])

@@ -232,6 +232,7 @@ class DNSResolverFSM(FSM):
     # -- states ------------------------------------------------------------
 
     def state_init(self, S):
+        S.validTransitions(['check_ns'])
         from .monitor import pool_monitor
         self.r_stopping = False
         pool_monitor.register_dns_resolver(self)
@@ -245,6 +246,7 @@ class DNSResolverFSM(FSM):
     def state_check_ns(self, S):
         """Figure out which nameservers to use: explicit IPs, a bootstrap
         name, or /etc/resolv.conf (reference lib/resolver.js:465-510)."""
+        S.validTransitions(['srv', 'bootstrap_ns'])
         from .resolver import _is_ip
         if self.r_resolvers:
             # 'host@port' is accepted for non-53 nameservers (test rigs);
@@ -276,6 +278,7 @@ class DNSResolverFSM(FSM):
             S.gotoState('srv')
 
     def state_bootstrap_ns(self, S):
+        S.validTransitions(['srv'])
         boot = self.r_bootstrap
 
         def on_added(k, srv):
@@ -287,10 +290,12 @@ class DNSResolverFSM(FSM):
             assert srv['address'] in self.r_resolvers
             self.r_resolvers.remove(srv['address'])
 
-        # Persistent listeners: survive this state (the bootstrap keeps
-        # feeding r_resolvers, reference lib/resolver.js:513-526).
-        boot.on('added', on_added)
-        boot.on('removed', on_removed)
+        # Persistent listeners: survive this state BY DESIGN (the
+        # bootstrap keeps feeding r_resolvers for the resolver's whole
+        # life, reference lib/resolver.js:513-526) — exempt from the
+        # state-scoped registration discipline.
+        boot.on('added', on_added)        # cbfsm: ignore=F006
+        boot.on('removed', on_removed)    # cbfsm: ignore=F006
 
         if boot.count() > 0:
             srvs = boot.list()
@@ -305,12 +310,14 @@ class DNSResolverFSM(FSM):
     # -- SRV section -------------------------------------------------------
 
     def state_srv(self, S):
+        S.validTransitions(['srv_try'])
         r = self.r_srv_retry
         r['delay'] = r['minDelay']
         r['count'] = r['max']
         S.gotoState('srv_try')
 
     def state_srv_try(self, S):
+        S.validTransitions(['aaaa', 'srv_error'])
         name = '%s.%s' % (self.r_service, self.r_domain)
         req = self.resolve(name, 'SRV', self.r_srv_retry['timeout'])
 
@@ -378,6 +385,7 @@ class DNSResolverFSM(FSM):
         req.send()
 
     def state_srv_error(self, S):
+        S.validTransitions(['srv_try', 'aaaa', 'sleep'])
         r = self.r_srv_retry
         r['count'] -= 1
         if r['count'] > 0:
@@ -418,6 +426,7 @@ class DNSResolverFSM(FSM):
     # -- AAAA section ------------------------------------------------------
 
     def state_aaaa(self, S):
+        S.validTransitions(['aaaa_next', 'a'])
         if have_global_v6():
             self.r_next_v6 = None
             self.r_srv_rem = list(self.r_srvs)
@@ -428,6 +437,7 @@ class DNSResolverFSM(FSM):
             S.gotoState('a')
 
     def state_aaaa_next(self, S):
+        S.validTransitions(['aaaa_try', 'a'])
         r = self.r_retry
         r['delay'] = r['minDelay']
         r['count'] = r['max']
@@ -438,6 +448,7 @@ class DNSResolverFSM(FSM):
             S.gotoState('a')
 
     def state_aaaa_try(self, S):
+        S.validTransitions(['aaaa_next', 'aaaa_error'])
         srv = self.r_srv
         from .resolver import _is_ip
 
@@ -490,6 +501,7 @@ class DNSResolverFSM(FSM):
         req.send()
 
     def state_aaaa_error(self, S):
+        S.validTransitions(['aaaa_try', 'aaaa_next'])
         r = self.r_retry
         r['count'] -= 1
         if r['count'] > 0:
@@ -507,11 +519,13 @@ class DNSResolverFSM(FSM):
     # -- A section ---------------------------------------------------------
 
     def state_a(self, S):
+        S.validTransitions(['a_next'])
         self.r_next_v4 = None
         self.r_srv_rem = list(self.r_srvs)
         S.gotoState('a_next')
 
     def state_a_next(self, S):
+        S.validTransitions(['a_try', 'process'])
         r = self.r_retry
         r['delay'] = r['minDelay']
         r['count'] = r['max']
@@ -522,6 +536,7 @@ class DNSResolverFSM(FSM):
             S.gotoState('process')
 
     def state_a_try(self, S):
+        S.validTransitions(['a_next', 'a_error'])
         srv = self.r_srv
         from .resolver import _is_ip
 
@@ -577,6 +592,7 @@ class DNSResolverFSM(FSM):
         req.send()
 
     def state_a_error(self, S):
+        S.validTransitions(['a_try', 'a_next'])
         r = self.r_retry
         r['count'] -= 1
         if r['count'] > 0:
@@ -596,6 +612,7 @@ class DNSResolverFSM(FSM):
     def state_process(self, S):
         """Diff new backends vs. old; emit 'removed' then 'added' then
         'updated' (reference lib/resolver.js:1024-1108)."""
+        S.validTransitions(['sleep'])
         from .resolver import srv_key
 
         old_backends = self.r_backends
@@ -649,6 +666,7 @@ class DNSResolverFSM(FSM):
         S.gotoState('sleep')
 
     def state_sleep(self, S):
+        S.validTransitions(['init', 'srv', 'aaaa', 'a'])
         if self.r_stopping:
             S.gotoState('init')
             return

@@ -144,9 +144,11 @@ class ResolverFSM(FSM):
     # -- states ----------------------------------------------------------
 
     def state_stopped(self, S):
+        S.validTransitions(['starting'])
         S.goto_state_on(self, 'startAsserted', 'starting')
 
     def state_starting(self, S):
+        S.validTransitions(['failed', 'running', 'stopping'])
         # Listener registered before start(): the reference relies on
         # inner resolvers deferring their 'updated' emission
         # (lib/resolver.js:113-116 starts first), but an inner that
@@ -162,9 +164,12 @@ class ResolverFSM(FSM):
         self.r_fsm.start()
 
     def state_running(self, S):
+        S.validTransitions(['stopping'])
         S.goto_state_on(self, 'stopAsserted', 'stopping')
 
     def state_failed(self, S):
+        S.validTransitions(['running', 'stopping'])
+
         def on_updated(err=None):
             if not err:
                 S.gotoState('running')
@@ -172,6 +177,7 @@ class ResolverFSM(FSM):
         S.goto_state_on(self, 'stopAsserted', 'stopping')
 
     def state_stopping(self, S):
+        S.validTransitions(['stopped'])
         self.r_fsm.stop()
         S.immediate(lambda: S.gotoState('stopped'))
 

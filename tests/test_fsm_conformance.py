@@ -1,0 +1,121 @@
+"""Static/dynamic FSM conformance (the cbfsm closing-the-loop test).
+
+tools/cbfsm.py proves the Moore machines well-formed *statically*; this
+test proves the analyzer itself cannot silently drift from the code: it
+attaches a transition tracer (cueball_tpu/fsm.py add_transition_tracer)
+while driving the pool and cset seeded soak scenarios — the heaviest
+multi-machine traffic the suite has — and asserts every transition
+observed at runtime is an edge of the statically extracted graph for
+that machine. If the extractor misses an edge-producing construct, the
+soak takes that edge and this test names it."""
+
+import importlib.util
+from pathlib import Path
+
+import pytest
+
+from cueball_tpu import fsm as mod_fsm
+
+from conftest import run_async
+import test_soak
+import test_soak_cset
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load_cbfsm():
+    spec = importlib.util.spec_from_file_location(
+        'cbfsm', ROOT / 'tools' / 'cbfsm.py')
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _static_graphs():
+    """class name -> (initial, allowed munged edge set). A state whose
+    targets the extractor could not fully resolve conservatively
+    allows its whole whitelist (or every state with none)."""
+    cbfsm = _load_cbfsm()
+    machines, violations = cbfsm.analyze_paths(
+        [str(ROOT / 'cueball_tpu')])
+    assert violations == [], [str(v) for v in violations]
+    out = {}
+    for m in machines:
+        allowed = set(m.edge_set())
+        for st in m.states.values():
+            if st.dynamic_targets:
+                targets = [k for k, _ in (st.declared or [])] or \
+                    list(m.states)
+                allowed.update((st.name, t) for t in targets)
+        out[m.class_name] = (m.initial, allowed)
+    return out
+
+
+def _graph_for(klass, graphs):
+    """Union the graphs of every class in the MRO that defines state
+    methods (a subclass machine only holds its own state_ defs)."""
+    initial = None
+    allowed = set()
+    found = False
+    for base in klass.__mro__:
+        g = graphs.get(base.__name__)
+        if g is None:
+            continue
+        found = True
+        if initial is None:
+            initial = g[0]
+        allowed |= g[1]
+    return (initial, allowed) if found else None
+
+
+def _run_traced(coro):
+    graphs = _static_graphs()
+    observed = []
+
+    def tracer(fsm_obj, old, new):
+        if type(fsm_obj).__module__.startswith('cueball_tpu'):
+            observed.append((type(fsm_obj), old, new))
+
+    mod_fsm.add_transition_tracer(tracer)
+    try:
+        run_async(coro, timeout=90)
+    finally:
+        mod_fsm.remove_transition_tracer(tracer)
+
+    assert observed, 'tracer saw no cueball_tpu transitions'
+    bad = []
+    classes = set()
+    for klass, old, new in observed:
+        g = _graph_for(klass, graphs)
+        if g is None:
+            bad.append('%s: no statically extracted machine'
+                       % klass.__name__)
+            continue
+        classes.add(klass.__name__)
+        initial, allowed = g
+        munged_new = new.replace('.', '_')
+        if old is None:
+            if munged_new != initial:
+                bad.append('%s: initial entry to "%s" but static '
+                           'initial is "%s"' % (klass.__name__, new,
+                                                initial))
+        elif (old.replace('.', '_'), munged_new) not in allowed:
+            bad.append('%s: runtime transition "%s" -> "%s" is not a '
+                       'statically extracted edge' % (klass.__name__,
+                                                      old, new))
+    assert not bad, '\n'.join(sorted(set(bad))[:10])
+    return classes
+
+
+@pytest.mark.parametrize('seed', [7, 23])
+def test_pool_soak_transitions_conform_to_static_graph(seed):
+    classes = _run_traced(test_soak._soak(seed, actions=200))
+    # The soak must actually have driven the interacting machines.
+    assert 'ConnectionPool' in classes
+    assert 'ConnectionSlotFSM' in classes
+
+
+@pytest.mark.parametrize('seed', [11])
+def test_cset_soak_transitions_conform_to_static_graph(seed):
+    classes = _run_traced(test_soak_cset._soak(seed, actions=200))
+    assert 'ConnectionSet' in classes

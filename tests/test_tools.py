@@ -105,6 +105,41 @@ def test_suppression_comment_silences(tmp_path):
     assert _codes(tmp_path, src) == set()
 
 
+def test_suppression_per_code_silences_only_named(tmp_path):
+    # '# cblint: ignore=S010' kills exactly S010 on that line; the
+    # other violations on the same line still fire.
+    src = b'import os;x=1  # cblint: ignore=S010\n'
+    codes = _codes(tmp_path, src)
+    assert 'S010' not in codes
+    assert 'S008' in codes and 'C101' in codes
+    src = b'import os;x=1  # cblint: ignore=S008,S010,C101\n'
+    assert _codes(tmp_path, src) == set()
+
+
+def test_suppression_per_code_wrong_code_still_fires(tmp_path):
+    src = b'x=1  # cblint: ignore=C101\n'
+    assert 'S010' in _codes(tmp_path, src)
+
+
+def test_json_output_mode(tmp_path, capsys):
+    bad = tmp_path / 'bad.py'
+    bad.write_bytes(b'import os\nx=1\n')
+    assert cblint.main(['--format=json', str(bad)]) == 1
+    out = capsys.readouterr().out
+    rows = [json.loads(line) for line in out.splitlines()]
+    assert rows, 'json mode printed no violations'
+    for row in rows:
+        assert set(row) == {'path', 'line', 'code', 'msg'}
+        assert row['path'] == str(bad)
+    assert {r['code'] for r in rows} == {'S010', 'C101'}
+    assert [r for r in rows if r['code'] == 'C101'][0]['line'] == 1
+    # Clean file in json mode: no output at all, exit 0.
+    good = tmp_path / 'good.py'
+    good.write_bytes(b'x = 1\n')
+    assert cblint.main(['--format=json', str(good)]) == 0
+    assert capsys.readouterr().out == ''
+
+
 def test_clean_pep8_file_passes(tmp_path):
     src = (b'"""Doc."""\n\n'
            b'import math\n\n\n'
@@ -473,3 +508,283 @@ def test_docs_code_span_as_link_target_renders_literal(tmp_path):
     a = (out / 'a.html').read_text()
     assert '<a href' not in a
     assert '<code>relative/path.md</code>' in a
+
+
+# ---------------------------------------------------------------------------
+# cbfsm: the Moore-FSM static analyzer — every rule, one seeded
+# machine each (docs/fsm-analysis.md is the rule catalogue)
+
+cbfsm = _load('cbfsm')
+
+
+def _fsm_codes(tmp_path, source: str, name='machine.py'):
+    p = tmp_path / name
+    p.write_text(source)
+    _, violations = cbfsm.analyze_file(p)
+    return {v.code for v in violations}
+
+
+FSM_CASES = [
+    # F001: gotoState target with no state_<name> method.
+    ('F001', '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        S.validTransitions(['b'])
+        S.gotoState('ghost')
+        S.gotoState('b')
+
+    def state_b(self, S):
+        S.validTransitions([])
+'''),
+    # F002: actual edge a->c missing from the whitelist.
+    ('F002', '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        S.validTransitions(['b'])
+        S.gotoState('b')
+        S.gotoState('c')
+
+    def state_b(self, S):
+        S.validTransitions([])
+
+    def state_c(self, S):
+        S.validTransitions([])
+'''),
+    # F003: declared edge a->c is never taken.
+    ('F003', '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        S.validTransitions(['b', 'c'])
+        S.gotoState('b')
+
+    def state_b(self, S):
+        S.validTransitions([])
+
+    def state_c(self, S):
+        S.validTransitions([])
+'''),
+    # F004: state_orphan has no inbound edge from the initial state.
+    ('F004', '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        S.validTransitions(['b'])
+        S.gotoState('b')
+
+    def state_b(self, S):
+        S.validTransitions([])
+
+    def state_orphan(self, S):
+        S.validTransitions([])
+'''),
+    # F005: state_a declares no validTransitions at all.
+    ('F005', '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        S.gotoState('b')
+
+    def state_b(self, S):
+        S.validTransitions([])
+'''),
+    # F006: raw listener registration instead of S.on.
+    ('F006', '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        S.validTransitions([])
+        self.emitter.on('evt', self.handle)
+'''),
+    # F006: raw loop scheduling instead of S.immediate/S.timeout.
+    ('F006', '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        S.validTransitions([])
+        loop.call_soon(self.poke)
+'''),
+    # F007: async state entry (and an await inside it).
+    ('F007', '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    async def state_a(self, S):
+        S.validTransitions([])
+        await self.thing()
+'''),
+]
+
+
+@pytest.mark.parametrize('code,src', FSM_CASES,
+                         ids=['%s-%d' % (c, i)
+                              for i, (c, _) in enumerate(FSM_CASES)])
+def test_fsm_rule_catches_seeded_violation(tmp_path, code, src):
+    assert code in _fsm_codes(tmp_path, src), \
+        '%s not raised for:\n%s' % (code, src)
+
+
+# A well-formed machine exercising every extraction path: event-gated
+# transitions (goto_state_on), timer transitions (goto_state_timeout),
+# a gated callback defined in the state body, a variable target
+# resolved by constant propagation, and a dotted sub-state.
+CLEAN_FSM = '''\
+class M:
+    def __init__(self):
+        super().__init__('idle')
+
+    def state_idle(self, S):
+        S.validTransitions(['running'])
+        S.goto_state_on(self, 'start', 'running')
+
+    def state_running(self, S):
+        S.validTransitions(['failed', 'stopping'])
+
+        def on_err(err):
+            S.gotoState('failed')
+        S.on(self, 'error', on_err)
+        S.goto_state_timeout(50, 'stopping')
+
+    def state_failed(self, S):
+        S.validTransitions(['stopping'])
+        which = 'stopping'
+        S.gotoState(which)
+
+    def state_stopping(self, S):
+        S.validTransitions(['stopping.wait'])
+        S.gotoState('stopping.wait')
+
+    def state_stopping_wait(self, S):
+        S.validTransitions([])
+'''
+
+
+def test_fsm_clean_machine_zero_false_positives(tmp_path):
+    assert _fsm_codes(tmp_path, CLEAN_FSM) == set()
+
+
+def test_fsm_edge_extraction_details(tmp_path):
+    p = tmp_path / 'machine.py'
+    p.write_text(CLEAN_FSM)
+    machines, _ = cbfsm.analyze_file(p)
+    assert len(machines) == 1
+    m = machines[0]
+    assert m.initial == 'idle'
+    assert m.edge_set() == {
+        ('idle', 'running'),          # via goto_state_on arg 2
+        ('running', 'failed'),        # via gated callback
+        ('running', 'stopping'),      # via goto_state_timeout arg 1
+        ('failed', 'stopping'),       # via constant propagation
+        ('stopping', 'stopping_wait'),
+    }
+    # Dotted sub-state keeps its display form for diagrams/messages.
+    assert m.display_name('stopping_wait') == 'stopping.wait'
+
+
+def test_fsm_suppression_bare_and_per_code(tmp_path):
+    bare = '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):  # cbfsm: ignore
+        S.gotoState('b')
+
+    def state_b(self, S):
+        S.validTransitions([])
+'''
+    assert _fsm_codes(tmp_path, bare) == set()
+    coded = bare.replace('# cbfsm: ignore', '# cbfsm: ignore=F005')
+    assert _fsm_codes(tmp_path, coded) == set()
+    wrong = bare.replace('# cbfsm: ignore', '# cbfsm: ignore=F001')
+    assert 'F005' in _fsm_codes(tmp_path, wrong)
+
+
+def test_fsm_json_output_mode(tmp_path, capsys):
+    p = tmp_path / 'machine.py'
+    p.write_text(FSM_CASES[0][1])
+    assert cbfsm.main(['--format=json', str(p)]) == 1
+    out = capsys.readouterr().out
+    rows = [json.loads(line) for line in out.splitlines()]
+    assert rows, 'json mode printed no violations'
+    for row in rows:
+        assert set(row) == {'path', 'line', 'code', 'msg'}
+        assert row['path'] == str(p)
+    assert 'F001' in {r['code'] for r in rows}
+
+
+def test_fsm_cli_exit_codes(tmp_path, capsys):
+    assert cbfsm.main([]) == 2               # no targets
+    capsys.readouterr()
+    good = tmp_path / 'machine.py'
+    good.write_text(CLEAN_FSM)
+    assert cbfsm.main([str(good)]) == 0
+    assert 'clean' in capsys.readouterr().out
+    bad = tmp_path / 'bad.py'
+    bad.write_text(FSM_CASES[0][1])
+    assert cbfsm.main([str(tmp_path)]) == 1
+    assert 'F001' in capsys.readouterr().out
+
+
+def test_fsm_repo_machines_are_clean():
+    machines, violations = cbfsm.analyze_paths(
+        [str(ROOT / 'cueball_tpu')])
+    assert violations == [], [str(v) for v in violations]
+    names = {m.class_name for m in machines}
+    assert {'ConnectionPool', 'ConnectionSet',
+            'ResolverFSM', 'DNSResolverFSM'} <= names
+
+
+def test_fsm_graph_write_and_stale_gate(tmp_path, capsys):
+    src = tmp_path / 'machine.py'
+    src.write_text(CLEAN_FSM)
+    out = tmp_path / 'fsm'
+    assert cbfsm.main(['--graphs', str(out), str(src)]) == 0
+    page = (out / 'm.md').read_text()
+    assert 'stateDiagram-v2' in page
+    assert '[*] --> idle' in page
+    assert 'stopping.wait' in page           # display alias survives
+    idx = (out / 'index.md').read_text()
+    assert '(m.md)' in idx
+    capsys.readouterr()
+    # Fresh graphs pass the gate...
+    assert cbfsm.main(['--check-graphs', str(out), str(src)]) == 0
+    capsys.readouterr()
+    # ...a hand-edited page is stale...
+    (out / 'm.md').write_text(page + 'edited\n')
+    assert cbfsm.main(['--check-graphs', str(out), str(src)]) == 1
+    assert 'stale' in capsys.readouterr().out
+    # ...and regeneration heals it and removes orphans.
+    (out / 'orphan.md').write_text('# gone\n')
+    assert cbfsm.main(['--graphs', str(out), str(src)]) == 0
+    assert not (out / 'orphan.md').exists()
+    capsys.readouterr()
+    assert cbfsm.main(['--check-graphs', str(out), str(src)]) == 0
+
+
+def test_fsm_committed_graphs_match_source():
+    """The stale-diagram gate `make ci` runs: docs/fsm must be exactly
+    what the code produces (run from the repo root so the pages'
+    source paths match the committed ones)."""
+    r = subprocess.run(
+        [sys.executable, str(ROOT / 'tools' / 'cbfsm.py'),
+         '--check-graphs', 'docs/fsm', 'cueball_tpu'],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -10,15 +10,19 @@ and a style half (line-based, the jsstyle analogue).
 
 Exit status is non-zero iff any violation is found. Suppress a single
 line with a trailing ``# cblint: ignore`` (the jsstyle
-``/* JSSTYLED */`` analogue).
+``/* JSSTYLED */`` analogue), or suppress specific codes only with
+``# cblint: ignore=S001,C101``.
 
-Usage: cblint.py [paths...]   (directories are walked for *.py)
+Usage: cblint.py [--format=json] [paths...]
+(directories are walked for *.py)
 """
 
 from __future__ import annotations
 
 import ast
 import io
+import json
+import re
 import sys
 import tokenize
 from pathlib import Path
@@ -26,6 +30,33 @@ from pathlib import Path
 MAX_LINE = 79
 SUPPRESS = '# cblint: ignore'
 INDENT_STEP = 4
+
+_SUPPRESS_RE = re.compile(
+    r'#\s*cblint:\s*ignore(?:=([A-Z0-9,\s]+))?\s*$')
+
+
+def parse_suppressions(text: str) -> dict:
+    """Map line number -> None (suppress everything) or a set of codes
+    (suppress only those), for every line carrying a suppression
+    comment."""
+    sup = {}
+    for i, line in enumerate(text.split('\n'), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m is None:
+            continue
+        codes = m.group(1)
+        if codes is None:
+            sup[i] = None
+        else:
+            sup[i] = {c.strip() for c in codes.split(',') if c.strip()}
+    return sup
+
+
+def is_suppressed(sup: dict, line: int, code: str) -> bool:
+    if line not in sup:
+        return False
+    codes = sup[line]
+    return codes is None or code in codes
 
 # Operators that unambiguously require surrounding whitespace (the
 # jsstyle operator-spacing analogue). Plain '=' is handled separately
@@ -49,43 +80,50 @@ class Violation:
         return '%s:%d: %s %s' % (self.path, self.line, self.code,
                                  self.msg)
 
+    def to_json(self) -> str:
+        return json.dumps({
+            'path': self.path,
+            'line': self.line,
+            'code': self.code,
+            'msg': self.msg,
+        }, sort_keys=True)
+
 
 def check_style(path: str, text: str) -> list[Violation]:
     """The jsstyle half: mechanical per-line rules."""
     out = []
     lines = text.split('\n')
+    sup = parse_suppressions(text)
+
+    def add(row, code, msg):
+        if not is_suppressed(sup, row, code):
+            out.append(Violation(path, row, code, msg))
+
     for i, line in enumerate(lines, 1):
-        if line.endswith(SUPPRESS):
-            continue
         if line.rstrip('\r') != line.rstrip('\r').rstrip():
-            out.append(Violation(path, i, 'S002', 'trailing whitespace'))
+            add(i, 'S002', 'trailing whitespace')
         if line.endswith('\r'):
-            out.append(Violation(path, i, 'S005', 'CRLF line ending'))
+            add(i, 'S005', 'CRLF line ending')
         stripped = line.expandtabs()
         if '\t' in line[:len(line) - len(line.lstrip())]:
-            out.append(Violation(path, i, 'S003', 'tab in indentation'))
+            add(i, 'S003', 'tab in indentation')
         if len(stripped) > MAX_LINE:
-            out.append(Violation(
-                path, i, 'S001',
-                'line too long (%d > %d)' % (len(stripped), MAX_LINE)))
+            add(i, 'S001',
+                'line too long (%d > %d)' % (len(stripped), MAX_LINE))
     if text and not text.endswith('\n'):
-        out.append(Violation(path, len(lines), 'S004',
-                             'no newline at end of file'))
+        add(len(lines), 'S004', 'no newline at end of file')
     if text.endswith('\n\n\n'):
-        out.append(Violation(path, len(lines), 'S006',
-                             'multiple blank lines at end of file'))
-    out.extend(check_token_style(path, text, lines))
+        add(len(lines), 'S006', 'multiple blank lines at end of file')
+    out.extend(check_token_style(path, text, sup))
     return out
 
 
 def check_token_style(path: str, text: str,
-                      lines: list[str]) -> list[Violation]:
+                      sup: dict) -> list[Violation]:
     """Tokenizer-based style rules (the jsstyle indentation/spacing
     half): S007 indent steps of exactly 4, S008 no multi-statement
     lines, S009 space after comma, S010 spaces around comparison /
     augmented-assignment / arrow / top-level '=' operators."""
-    sup = {i for i, line in enumerate(lines, 1)
-           if line.endswith(SUPPRESS)}
     try:
         toks = list(tokenize.generate_tokens(
             io.StringIO(text).readline))
@@ -94,7 +132,7 @@ def check_token_style(path: str, text: str,
     out = []
 
     def add(row, code, msg):
-        if row not in sup:
+        if not is_suppressed(sup, row, code):
             out.append(Violation(path, row, code, msg))
 
     depth = 0
@@ -175,9 +213,9 @@ def check_token_style(path: str, text: str,
 class _CorrectnessVisitor(ast.NodeVisitor):
     """The jsl half: AST rules that catch real bugs."""
 
-    def __init__(self, path, suppressed_lines):
+    def __init__(self, path, suppressions):
         self.path = path
-        self.suppressed = suppressed_lines
+        self.sup = suppressions
         self.out = []
         # import bookkeeping: alias -> (lineno, dotted name)
         self.imports = {}
@@ -185,7 +223,7 @@ class _CorrectnessVisitor(ast.NodeVisitor):
         self.export_all = False
 
     def _add(self, node, code, msg):
-        if node.lineno in self.suppressed:
+        if is_suppressed(self.sup, node.lineno, code):
             return
         self.out.append(Violation(self.path, node.lineno, code, msg))
 
@@ -344,7 +382,7 @@ class _CorrectnessVisitor(ast.NodeVisitor):
             if name.startswith('_'):
                 continue
             if name not in self.used_names:
-                if lineno in self.suppressed:
+                if is_suppressed(self.sup, lineno, 'C101'):
                     continue
                 self.out.append(Violation(
                     self.path, lineno, 'C101',
@@ -357,9 +395,7 @@ def check_correctness(path: str, text: str) -> list[Violation]:
     except SyntaxError as e:
         return [Violation(path, e.lineno or 0, 'C100',
                           'syntax error: %s' % e.msg)]
-    suppressed = {i for i, line in enumerate(text.split('\n'), 1)
-                  if line.endswith(SUPPRESS)}
-    v = _CorrectnessVisitor(path, suppressed)
+    v = _CorrectnessVisitor(path, parse_suppressions(text))
     v.visit(tree)
     v.finish(tree, text)
     return v.out
@@ -384,7 +420,14 @@ def iter_targets(args: list[str]):
 
 
 def main(argv: list[str]) -> int:
-    targets = list(iter_targets(argv)) or []
+    as_json = False
+    paths = []
+    for a in argv:
+        if a == '--format=json':
+            as_json = True
+        else:
+            paths.append(a)
+    targets = list(iter_targets(paths)) or []
     if not targets:
         print('cblint: no targets', file=sys.stderr)
         return 2
@@ -392,12 +435,14 @@ def main(argv: list[str]) -> int:
     for t in targets:
         violations.extend(lint_file(t))
     for v in violations:
-        print(v)
+        print(v.to_json() if as_json else v)
     if violations:
-        print('cblint: %d violation(s) in %d file(s)' % (
-            len(violations), len({v.path for v in violations})))
+        if not as_json:
+            print('cblint: %d violation(s) in %d file(s)' % (
+                len(violations), len({v.path for v in violations})))
         return 1
-    print('cblint: %d file(s) clean' % len(targets))
+    if not as_json:
+        print('cblint: %d file(s) clean' % len(targets))
     return 0
 
 
