@@ -266,6 +266,77 @@ async def bench_queued_claim_throughput():
     return statistics.mean(rates), statistics.stdev(rates)
 
 
+# Small trials: this stage exists to bound the *disabled* cost of the
+# claim tracer (one module-global load + None check per claim), not to
+# re-measure absolute throughput — bench_claim_throughput owns that.
+TRACING_AB_OPS_PER_TRIAL = 3000
+TRACING_AB_TRIALS = 5
+
+
+async def bench_tracing_ab(ops=TRACING_AB_OPS_PER_TRIAL,
+                           trials=TRACING_AB_TRIALS):
+    """Tracing-off vs tracing-on claim-path A/B.
+
+    Every round runs three interleaved arms — off-pre, on, off-post —
+    so slow host drift (thermal, noisy neighbours) lands on all three
+    equally. The pair that matters for the guard is off-post vs
+    off-pre: both run with tracing disabled, one before and one after
+    an enabled arm, so any gap between them is pure noise floor plus
+    whatever state the tracer failed to tear down. on vs off measures
+    the opt-in cost of full sampling for the JSON record."""
+    import gc
+    import statistics
+    from cueball_tpu import trace as mod_trace
+    build_pool = make_fixture()
+
+    async def one_trial(tracing):
+        pool = build_pool()
+        await settle(pool)
+        gc.collect()
+        if tracing:
+            mod_trace.enable_tracing(ring_size=256, sample_rate=1.0)
+        try:
+            gc.disable()
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                hdl, conn = await pool.claim({'timeout': 1000})
+                hdl.release()
+            elapsed = time.perf_counter() - t0
+            gc.enable()
+        finally:
+            if tracing:
+                mod_trace.disable_tracing()
+        pool.stop()
+        while not pool.is_in_state('stopped'):
+            await asyncio.sleep(0.01)
+        return ops / elapsed
+
+    arms = {'off_pre': [], 'on': [], 'off_post': []}
+    for trial in range(trials + 1):
+        if trial == 1:
+            gc.collect()
+            gc.freeze()
+        rates = {arm: await one_trial(arm == 'on') for arm in arms}
+        if trial > 0:            # trial 0 is warmup
+            for arm, rate in rates.items():
+                arms[arm].append(rate)
+
+    out = {}
+    for arm, xs in arms.items():
+        out[arm + '_ops_per_sec'] = round(statistics.mean(xs), 1)
+        out[arm + '_stdev'] = round(
+            statistics.stdev(xs) if len(xs) > 1 else 0.0, 1)
+        out[arm + '_trials'] = [round(r, 1) for r in xs]
+    off = statistics.mean(arms['off_pre'] + arms['off_post'])
+    on = statistics.mean(arms['on'])
+    out['tracing_on_overhead_pct'] = round(100.0 * (off - on) / off, 2)
+    out['protocol'] = ('%d rounds x %d ops x 3 interleaved arms '
+                       '(off-pre / on / off-post), 1 warmup round, '
+                       'gc frozen+disabled in timed sections') % (
+        trials, ops)
+    return out
+
+
 def _default_is_pallas():
     """Ask telemetry which FIR path it actually ships here.
 
@@ -697,7 +768,8 @@ def artifact_citation(root: str | None = None) -> dict:
     }}
 
 
-def assemble_result(abs_err, claim, queued, host_tick, telem) -> dict:
+def assemble_result(abs_err, claim, queued, host_tick, telem,
+                    tracing_ab=None) -> dict:
     """Build the single JSON-line result from the stage outputs.
 
     Factored out of main() so the guard tests can assert the
@@ -760,6 +832,8 @@ def assemble_result(abs_err, claim, queued, host_tick, telem) -> dict:
         'device': telem.get('device'),
         'targets_ms': TARGETS,
     }
+    if tracing_ab is not None:
+        result['claim_tracing_ab'] = tracing_ab
     if telem.get('error') is not None:
         result['telemetry_error'] = telem['error']
     if telem.get('pools_per_sec_live') is None:
@@ -796,10 +870,12 @@ async def main(host_only: bool = False):
     abs_err = await bench_codel_tracking()
     claim = await bench_claim_throughput()
     queued = await bench_queued_claim_throughput()
+    tracing_ab = await bench_tracing_ab()
     host_tick = bench_sampler_tick_host()
     telem = {} if host_only else bench_telemetry_step_guarded()
 
-    result = assemble_result(abs_err, claim, queued, host_tick, telem)
+    result = assemble_result(abs_err, claim, queued, host_tick, telem,
+                             tracing_ab=tracing_ab)
     if host_only:
         result['host_only'] = True
     print(json.dumps(result))

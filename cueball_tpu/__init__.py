@@ -58,6 +58,12 @@ from .pool import ConnectionPool
 from .monitor import pool_monitor
 from .cset import ConnectionSet
 from .agent import HttpAgent, HttpsAgent
+from .trace import (
+    enable_tracing,
+    disable_tracing,
+    tracing_enabled,
+    trace_ring,
+)
 from .debug import (
     dump_fsm_histories,
     install_debug_handler,
@@ -87,6 +93,8 @@ __all__ = [
     'HttpAgent', 'HttpsAgent',
     'pool_monitor', 'poolMonitor', 'enableStackTraces',
     'dump_fsm_histories', 'install_debug_handler',
+    'enable_tracing', 'disable_tracing', 'tracing_enabled',
+    'trace_ring',
     'EventEmitter', 'FSM', 'Queue', 'ControlledDelay',
     'enable_stack_traces', 'stack_traces_enabled', 'current_millis',
     'plan_rebalance',

@@ -32,6 +32,10 @@ class ControlledDelay:
         self.cd_count = 0
         self.cd_dropping = False
         self.cd_last_empty: float | None = None
+        # Last overloaded() decision detail, for claim-trace 'codel'
+        # event spans: (sojourn_ms, dropping_mode, drop_count).
+        self.cd_last_sojourn = 0.0
+        self.cd_last_decision: bool | None = None
 
     def can_drop(self, now: float, start: float) -> bool:
         sojourn = now - start
@@ -50,6 +54,7 @@ class ControlledDelay:
         """Given a claim's enqueue time, decide drop-on-dequeue
         (reference lib/codel.js:52-86)."""
         now = current_millis()
+        self.cd_last_sojourn = now - start
         ok_to_drop = self.can_drop(now, start)
         drop_claim = False
 
@@ -70,6 +75,7 @@ class ControlledDelay:
                 self.cd_count = 1
             self.cd_drop_next = self.get_drop_next(now)
 
+        self.cd_last_decision = drop_claim
         return drop_claim
 
     def empty(self) -> None:

@@ -33,6 +33,7 @@ import logging
 import math
 
 from . import errors as mod_errors
+from . import trace as mod_trace
 from . import utils as mod_utils
 from .events import _native
 from .fsm import FSM, get_loop
@@ -144,6 +145,11 @@ class SocketMgrFSM(FSM):
         self.sm_last_error = None
         self.sm_socket = None
         self.sm_monitor: bool | None = None
+        # Last completed connect as (start_ms, end_ms): claim traces
+        # attach it as their 'connect' child span, whether the connect
+        # happened during the claim or predates it (trace.py).
+        self.sm_connect_started = None
+        self.sm_last_connect = None
 
         super().__init__('init')
         self.set_monitor(bool(options['monitor']))
@@ -252,6 +258,7 @@ class SocketMgrFSM(FSM):
     def state_connecting(self, S):
         S.validTransitions(['connected', 'error'])
         self._sm_telemetry_dirty()   # may be leaving 'backoff'
+        self.sm_connect_started = mod_utils.current_millis()
 
         def on_timeout():
             self.sm_last_error = mod_errors.ConnectionTimeoutError(
@@ -306,6 +313,14 @@ class SocketMgrFSM(FSM):
         S.validTransitions(['error', 'closed'])
 
         self.sm_log.debug('connected')
+        if self.sm_connect_started is not None:
+            now = mod_utils.current_millis()
+            self.sm_last_connect = (self.sm_connect_started, now)
+            self.sm_connect_started = None
+            tracer = mod_trace._runtime
+            if tracer is not None:
+                tracer.connect_done(self.sm_backend.get('key'),
+                                    *self.sm_last_connect)
         self.reset_backoff()
 
         @_internal
@@ -418,6 +433,8 @@ class CueBallClaimHandle(FSM):
         self.ch_do_release_leak_check = True
         self.ch_pinger = False
         self.ch_started = mod_utils.current_millis()
+        self.ch_trace = None  # ClaimTrace, attached by the pool/set
+        #                       when tracing is enabled (trace.py)
 
         super().__init__('waiting')
 
@@ -574,6 +591,10 @@ class CueBallClaimHandle(FSM):
         S.validTransitions(['claiming', 'cancelled', 'failed'])
 
         self.ch_slot = None
+        if self.ch_trace is not None:
+            # No-op on the first entry; after a rejected handshake it
+            # closes the handshake span and opens a new queue_wait.
+            self.ch_trace.requeued()
         if self.ch_requeue is not None:
             # Re-entry after a rejected claim: ask the pool to try
             # again next tick (the initial entry runs during __init__,
@@ -618,6 +639,8 @@ class CueBallClaimHandle(FSM):
         S.validTransitions(['claimed', 'waiting', 'cancelled'])
 
         self._ch_unpark()
+        if self.ch_trace is not None:
+            self.ch_trace.claiming(self.ch_slot)
         S.goto_state_on(self, 'accepted', 'claimed')
 
         def on_rejected():
@@ -634,6 +657,9 @@ class CueBallClaimHandle(FSM):
 
         S.goto_state_on(self, 'releaseAsserted', 'released')
         S.goto_state_on(self, 'closeAsserted', 'closed')
+
+        if self.ch_trace is not None:
+            self.ch_trace.claimed()
 
         if self.ch_cancelled:
             S.gotoState('released')
@@ -662,6 +688,8 @@ class CueBallClaimHandle(FSM):
 
     def state_released(self, S):
         S.validTransitions([])
+        if self.ch_trace is not None:
+            self.ch_trace.released('release')
         if not self.ch_do_release_leak_check:
             return
         conn = self.ch_connection
@@ -677,16 +705,22 @@ class CueBallClaimHandle(FSM):
     def state_closed(self, S):
         S.validTransitions([])
         # No leak check: the connection is being closed anyway.
+        if self.ch_trace is not None:
+            self.ch_trace.released('close')
 
     def state_cancelled(self, S):
         S.validTransitions([])
         self._ch_unpark()
+        if self.ch_trace is not None:
+            self.ch_trace.cancelled()
         # Public API contract: the callback is never called after
         # cancel() (reference lib/connection-fsm.js:770-777).
 
     def state_failed(self, S):
         S.validTransitions([])
         self._ch_unpark()
+        if self.ch_trace is not None:
+            self.ch_trace.failed(self.ch_last_error)
         S.immediate(lambda: self.ch_callback(self.ch_last_error))
 
 

@@ -28,6 +28,7 @@ import math
 import time
 import uuid as mod_uuid
 
+from . import trace as mod_trace
 from . import utils as mod_utils
 from .connection_fsm import ConnectionSlotFSM, CueBallClaimHandle
 from .events import EventEmitter
@@ -549,6 +550,11 @@ class LogicalConnection(FSM):
             'throwError': not self.lc_set.cs_conn_handles_err,
             'claimTimeout': math.inf,
         })
+        tracer = mod_trace._runtime
+        if tracer is not None:
+            # Set claims trace too (the ConnectionSet stands in as the
+            # 'pool'; ClaimTrace getattr-guards every pool access).
+            tracer.claim_begin(self.lc_hdl, self.lc_set)
 
         # Keep trying until claimed; fine to retry here since 'added' has
         # not been emitted yet for this ckey

@@ -30,6 +30,7 @@ import os
 import signal
 import time
 
+from . import trace as mod_trace
 from . import utils as mod_utils
 
 _LOG = logging.getLogger('cueball.debug')
@@ -98,6 +99,13 @@ def dump_fsm_histories(stream=None) -> str:
     for uuid, res in list(pool_monitor.pm_dns_res.items()):
         buf.write('dns_res %s domain=%s\n' % (uuid, res.r_domain))
         buf.write(_fsm_line('(resolver)', res))
+
+    # When claim tracing is on, the slowest recent claims land next to
+    # the FSM states: a wedged process's dump answers both "what state
+    # is everything in" and "where did claim latency go".
+    traces = mod_trace.dump_traces()
+    if traces:
+        buf.write(traces)
 
     report = buf.getvalue()
     if stream is not None:

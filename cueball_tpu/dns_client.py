@@ -301,7 +301,25 @@ class DnsClient:
         asyncio.ensure_future(self._lookup(opts, cb))
 
     async def _query_one(self, resolver: str, domain: str, qtype: str,
-                         timeout_s: float) -> DnsMessage:
+                         timeout_s: float, trace=None) -> DnsMessage:
+        """One resolver's attempt; when a DnsTrace rides along in
+        opts['trace'], the whole attempt (UDP, EDNS fallback, TC->TCP)
+        becomes one 'dns_query' span with its outcome."""
+        if trace is None:
+            return await self._query_wire(resolver, domain, qtype,
+                                          timeout_s)
+        span = trace.query_begin(resolver)
+        try:
+            msg = await self._query_wire(resolver, domain, qtype,
+                                         timeout_s)
+        except BaseException as err:
+            trace.query_end(span, type(err).__name__)
+            raise
+        trace.query_end(span, 'ok')
+        return msg
+
+    async def _query_wire(self, resolver: str, domain: str, qtype: str,
+                          timeout_s: float) -> DnsMessage:
         host, _, portstr = resolver.partition('@')
         port = int(portstr) if portstr else 53
         qid = random.randrange(65536)
@@ -351,6 +369,7 @@ class DnsClient:
             cb(MultiError([DnsError('SERVFAIL', domain)]), None)
             return
         threshold = opts.get('errorThreshold') or len(resolvers)
+        trace = opts.get('trace')
 
         random.shuffle(resolvers)
         resolvers = resolvers[:threshold]
@@ -367,7 +386,7 @@ class DnsClient:
             for wave in waves:
                 tasks = [
                     asyncio.ensure_future(self._query_one(
-                        r, domain, qtype, per_wave_s))
+                        r, domain, qtype, per_wave_s, trace=trace))
                     for r in wave]
                 try:
                     pending = set(tasks)

@@ -40,6 +40,7 @@ import time
 import uuid as mod_uuid
 
 from . import dns_client as mod_nsc
+from . import trace as mod_trace
 from . import utils as mod_utils
 from .events import EventEmitter
 from .fsm import FSM
@@ -713,9 +714,25 @@ class DNSResolverFSM(FSM):
                 self.r_maxres, len(self.r_resolvers))
 
         em = EventEmitter()
-        em.send = lambda: self.r_nsclient.lookup(opts, on_lookup)
+
+        def send():
+            # Each send() is one wire lookup: give it its own DnsTrace
+            # (dns_client adds a dns_query child span per resolver).
+            tracer = mod_trace._runtime
+            if tracer is not None:
+                opts['trace'] = tracer.dns_begin(domain, rtype)
+            self.r_nsclient.lookup(opts, on_lookup)
+        em.send = send
 
         def on_lookup(err, msg):
+            dns_trace = opts.get('trace')
+            if dns_trace is not None:
+                # Wire round-trip is over (post-processing below is
+                # local); rcode voting may still rewrite err for the
+                # caller, but the wire outcome is what we time.
+                dns_trace.done('error' if err is not None else 'ok',
+                               err)
+                opts['trace'] = None
             # Multi-error: the responding resolvers vote for the most
             # common rcode (reference lib/resolver.js:1227-1259).
             if err is not None and \

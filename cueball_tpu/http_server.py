@@ -16,6 +16,7 @@ from PoolMonitor.to_kang_options().
     GET /kang/objects/<type>    - ids of registered objects of a type
     GET /kang/obj/<type>/<id>   - one object's snapshot
     GET /kang/fleet             - attached FleetSampler's batched decisions
+    GET /kang/traces            - claim/DNS trace ring as NDJSON spans
     GET /metrics                - prometheus text metrics (collector)
 """
 
@@ -25,6 +26,7 @@ import asyncio
 import json
 import os
 
+from . import trace as mod_trace
 from .monitor import pool_monitor
 
 _MAX_HEADERS = 64
@@ -143,6 +145,11 @@ def _route(method: str, path: str, collector):
         elif path == '/kang/fleet':
             body = json.dumps(pool_monitor.fleet_snapshot(),
                               default=_json_default).encode()
+        elif path == '/kang/traces':
+            # Completed claim/DNS traces, one OTLP-field-named span per
+            # line (see trace.py). Empty body when tracing is off.
+            body = mod_trace.export_ndjson().encode()
+            ctype = 'application/x-ndjson'
         elif path == '/metrics' and collector is not None:
             body = collector.collect().encode()
             ctype = 'text/plain; version=0.0.4'

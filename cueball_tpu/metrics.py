@@ -3,7 +3,9 @@
 The reference depends on Joyent's `artedi` for its error-event counter
 (reference lib/utils.js:24,395-444; README.adoc:113,137 documents sharing a
 collector across pools/agents). This is a minimal compatible rebuild:
-label-keyed counters/gauges/histograms with a text-format serializer.
+label-keyed counters/gauges/histograms with a text-format serializer
+(exposition format v0.0.4: label values escaped, no braces on empty
+label sets, histograms as cumulative `_bucket{le=...}` + `_sum`/`_count`).
 """
 
 from __future__ import annotations
@@ -17,6 +19,27 @@ def _label_key(labels: dict | None) -> tuple:
     return tuple(sorted(labels.items()))
 
 
+def _escape_label_value(value) -> str:
+    """Escape a label value per the text-format spec: backslash, double
+    quote and newline must be backslash-escaped or they corrupt the whole
+    payload (a raw '"' ends the value early; a raw newline ends the
+    sample line)."""
+    return (str(value)
+            .replace('\\', '\\\\')
+            .replace('"', '\\"')
+            .replace('\n', '\\n'))
+
+
+def _format_sample(name: str, key: tuple, value: float) -> str:
+    """One exposition line. Empty label sets render with no braces at
+    all ('name value', not 'name{} value')."""
+    if not key:
+        return '%s %g' % (name, value)
+    lbl = ','.join('%s="%s"' % (k, _escape_label_value(val))
+                   for k, val in key)
+    return '%s{%s} %g' % (name, lbl, value)
+
+
 class Counter:
     metric_type = 'counter'
 
@@ -28,29 +51,35 @@ class Counter:
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
 
-    def increment(self, labels: dict | None = None, value: float = 1) -> None:
+    def _merged_key(self, labels: dict | None) -> tuple:
         merged = dict(self._static)
         merged.update(labels or {})
-        key = _label_key(merged)
+        return _label_key(merged)
+
+    def increment(self, labels: dict | None = None, value: float = 1) -> None:
+        key = self._merged_key(labels)
         with self._lock:
             self._values[key] = self._values.get(key, 0) + value
 
     add = increment
 
     def value(self, labels: dict | None = None) -> float:
-        merged = dict(self._static)
-        merged.update(labels or {})
-        return self._values.get(_label_key(merged), 0)
+        return self._values.get(self._merged_key(labels), 0)
 
     def total(self) -> float:
         return sum(self._values.values())
+
+    def remove(self, labels: dict | None = None) -> None:
+        """Drop one labeled sample row (e.g. a gauge for a pool that has
+        been stopped); a no-op when the row never existed."""
+        with self._lock:
+            self._values.pop(self._merged_key(labels), None)
 
     def serialize(self) -> str:
         out = ['# HELP %s %s' % (self.name, self.help),
                '# TYPE %s %s' % (self.name, self.metric_type)]
         for key, v in sorted(self._values.items()):
-            lbl = ','.join('%s="%s"' % (k, val) for k, val in key)
-            out.append('%s{%s} %g' % (self.name, lbl, v))
+            out.append(_format_sample(self.name, key, v))
         return '\n'.join(out) + '\n'
 
 
@@ -58,46 +87,145 @@ class Gauge(Counter):
     metric_type = 'gauge'
 
     def set(self, value: float, labels: dict | None = None) -> None:
+        key = self._merged_key(labels)
+        with self._lock:
+            self._values[key] = value
+
+
+# Milliseconds-oriented default buckets: the claim path operates between
+# sub-millisecond (hot cycle) and tens of seconds (connect timeouts).
+DEFAULT_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                   1000, 2500, 5000, 10000)
+
+
+class Histogram:
+    """Cumulative histogram (fixed buckets, upper-bound inclusive).
+
+    Serialized per the text format as `name_bucket{le="..."}` rows (the
+    `le="+Inf"` bucket always equals `name_count`), plus `name_sum` and
+    `name_count`."""
+
+    metric_type = 'histogram'
+
+    def __init__(self, name: str, help: str = '',
+                 static_labels: dict | None = None,
+                 buckets: tuple | None = None):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._static = dict(static_labels or {})
+        # label key -> [counts per bucket + inf, sum, count]
+        self._series: dict[tuple, list] = {}
+        self._lock = threading.Lock()
+
+    def _merged_key(self, labels: dict | None) -> tuple:
         merged = dict(self._static)
         merged.update(labels or {})
+        return _label_key(merged)
+
+    def observe(self, value: float, labels: dict | None = None) -> None:
+        key = self._merged_key(labels)
         with self._lock:
-            self._values[_label_key(merged)] = value
+            series = self._series.get(key)
+            if series is None:
+                series = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[key] = series
+            counts, _, _ = series
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            series[1] += value
+            series[2] += 1
+
+    def count(self, labels: dict | None = None) -> int:
+        series = self._series.get(self._merged_key(labels))
+        return series[2] if series is not None else 0
+
+    def sum(self, labels: dict | None = None) -> float:
+        series = self._series.get(self._merged_key(labels))
+        return series[1] if series is not None else 0.0
+
+    def remove(self, labels: dict | None = None) -> None:
+        with self._lock:
+            self._series.pop(self._merged_key(labels), None)
+
+    def serialize(self) -> str:
+        out = ['# HELP %s %s' % (self.name, self.help),
+               '# TYPE %s %s' % (self.name, self.metric_type)]
+        for key, series in sorted(self._series.items()):
+            counts, total, n = series
+            cum = 0
+            for i, le in enumerate(self.buckets):
+                cum += counts[i]
+                bkey = key + (('le', '%g' % le),)
+                out.append(_format_sample(self.name + '_bucket', bkey, cum))
+            bkey = key + (('le', '+Inf'),)
+            out.append(_format_sample(self.name + '_bucket', bkey, n))
+            out.append(_format_sample(self.name + '_sum', key, total))
+            out.append(_format_sample(self.name + '_count', key, n))
+        return '\n'.join(out) + '\n'
 
 
 class Collector:
-    """Registry of named metrics; counter() declarations are idempotent
-    (the reference relies on this when an agent-created collector is passed
-    down into pools, lib/utils.js:405-416)."""
+    """Registry of named metrics; declarations are idempotent (the
+    reference relies on this when an agent-created collector is passed
+    down into pools, lib/utils.js:405-416) but re-declaring a name as a
+    different metric type raises TypeError."""
 
     def __init__(self, labels: dict | None = None):
         self._labels = dict(labels or {})
-        self._metrics: dict[str, Counter] = {}
+        self._metrics: dict[str, Counter | Histogram] = {}
+        self._hooks: tuple = ()
         self._lock = threading.Lock()
 
-    def counter(self, name: str, help: str = '') -> Counter:
+    def _declare(self, name: str, help: str, metric_type: str, factory):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = Counter(name, help, self._labels)
+                m = factory()
                 self._metrics[name] = m
+            elif m.metric_type != metric_type:
+                raise TypeError(
+                    'metric %r already registered as a %s, not a %s' %
+                    (name, m.metric_type, metric_type))
             return m
+
+    def counter(self, name: str, help: str = '') -> Counter:
+        return self._declare(
+            name, help, 'counter',
+            lambda: Counter(name, help, self._labels))
 
     def gauge(self, name: str, help: str = '') -> Gauge:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = Gauge(name, help, self._labels)
-                self._metrics[name] = m
-            assert isinstance(m, Gauge)
-            return m
+        return self._declare(
+            name, help, 'gauge',
+            lambda: Gauge(name, help, self._labels))
 
-    def get_collector(self, name: str) -> Counter:
+    def histogram(self, name: str, help: str = '',
+                  buckets: tuple | None = None) -> Histogram:
+        return self._declare(
+            name, help, 'histogram',
+            lambda: Histogram(name, help, self._labels, buckets))
+
+    def get_collector(self, name: str) -> Counter | Histogram:
         return self._metrics[name]
 
     getCollector = get_collector
 
+    def add_collect_hook(self, fn) -> None:
+        """Register fn() to run at the top of collect(): lets gauges be
+        refreshed lazily at scrape time instead of on every pool event."""
+        self._hooks = self._hooks + (fn,)
+
+    def remove_collect_hook(self, fn) -> None:
+        self._hooks = tuple(h for h in self._hooks if h is not fn)
+
     def collect(self) -> str:
         """Serialize all metrics in Prometheus text format."""
+        for fn in self._hooks:
+            fn()
         return ''.join(m.serialize() for m in self._metrics.values())
 
 

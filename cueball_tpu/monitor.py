@@ -221,6 +221,11 @@ class PoolMonitor:
                 id_: self.get(t, id_) for id_ in self.list_objects(t)}
         if self.pm_fleet is not None:
             out['fleet'] = self.fleet_snapshot()
+        from . import trace as mod_trace
+        if mod_trace.tracing_enabled():
+            # Ring occupancy + sampling counters (the spans themselves
+            # are served raw by GET /kang/traces).
+            out['traces'] = mod_trace.summary()
         return out
 
 

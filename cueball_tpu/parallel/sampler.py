@@ -50,6 +50,7 @@ import math
 import typing
 from collections.abc import Mapping
 
+from .. import trace as mod_trace
 from .. import utils as mod_utils
 from ..events import EventEmitter
 from ..monitor import pool_monitor as default_monitor
@@ -599,9 +600,16 @@ class FleetSampler:
         self.fs_latest = record
         if self.fs_record:
             self.fs_history.append(record)
-        if self.fs_collector is not None:
+        # Publish fleet gauges onto this sampler's collector, falling
+        # back to the claim tracer's canonical metric surface when the
+        # sampler was built without one (so one /metrics endpoint
+        # carries both the per-pool trace gauges and the fleet row).
+        collector = self.fs_collector
+        if collector is None:
+            collector = mod_trace.active_collector()
+        if collector is not None:
             for name, help_ in _FLEET_GAUGES.items():
-                self.fs_collector.gauge(
+                collector.gauge(
                     'cueball_fleet_' + name, help_).set(fleet_np[name])
         return record
 

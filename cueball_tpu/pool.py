@@ -32,6 +32,7 @@ import uuid as mod_uuid
 
 from . import codel as mod_codel
 from . import errors as mod_errors
+from . import trace as mod_trace
 from . import utils as mod_utils
 from .connection_fsm import ConnectionSlotFSM, CueBallClaimHandle
 from .cqueue import Queue
@@ -466,6 +467,7 @@ class ConnectionPool(FSM):
         target = self.p_codel.cd_targdelay
         interval = mod_codel.CODEL_INTERVAL
         comp = self._pace_comp()
+        tracer = mod_trace._runtime
         head_over = False
         while len(self.p_waiters) > 0:
             hdl = self.p_waiters.peek()
@@ -486,6 +488,8 @@ class ConnectionPool(FSM):
                 break
             self.p_waiters.shift()
             self._incr_counter('codel-paced-drop')
+            if tracer is not None:
+                tracer.codel_shed(hdl, 'paced', soj, target)
             self._pace_account(soj - target)
             hdl.timeout()
         if head_over:
@@ -910,11 +914,24 @@ class ConnectionPool(FSM):
                             self.p_last_dequeue - hdl.ch_started -
                             self.p_codel.cd_targdelay)
                     if drop:
+                        tracer = mod_trace._runtime
+                        if tracer is not None:
+                            tracer.codel_shed(
+                                hdl, 'dequeue',
+                                self.p_last_dequeue - hdl.ch_started,
+                                self.p_codel.cd_targdelay)
                         hdl.timeout()
                         continue
                     # Service is live again; waiters may remain queued
                     # behind this one, so resume pacing.
                     self._arm_codel_pacer()
+                    if hdl.ch_trace is not None:
+                        if self.p_codel is not None:
+                            hdl.ch_trace.codel_decision(
+                                'served',
+                                self.p_last_dequeue - hdl.ch_started,
+                                self.p_codel.cd_targdelay)
+                        hdl.ch_trace.slot_selected('drain')
                     hdl.try_(fsm)
                     return
 
@@ -1070,6 +1087,11 @@ class ConnectionPool(FSM):
             'claimTimeout': timeout,
         })
 
+        # Tracing off: one module-global load + None check per claim.
+        tracer = mod_trace._runtime
+        if tracer is not None:
+            tracer.claim_begin(handle, self)
+
         def try_next():
             if not handle.is_in_state('waiting'):
                 return
@@ -1085,6 +1107,8 @@ class ConnectionPool(FSM):
                 # The idleq shift moved the busy count NOW; the slot's
                 # 'busy' stateChanged only lands next loop turn.
                 self._telemetry_dirty()
+                if handle.ch_trace is not None:
+                    handle.ch_trace.slot_selected('idleq')
                 handle.try_(fsm)
                 return
 

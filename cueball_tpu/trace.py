@@ -1,0 +1,569 @@
+"""Claim-path span tracing: where did a claim's latency actually go?
+
+The kang snapshot and the fleet sampler expose *structure* (FSM states,
+queue depths); this module records *behavior*. When tracing is enabled,
+every pool claim carries a `ClaimTrace` — a flat list of spans with
+OTLP-compatible field names (trace_id / span_id / parent_span_id /
+start / end / attrs) — decomposing its life into queue wait, CoDel
+admission decisions, slot selection, connect + handshake, lease-held
+time and release/requeue. DNS lookups get their own `DnsTrace` with one
+child span per resolver attempt.
+
+Completed traces land in a bounded per-process ring (O(1) append,
+oldest dropped) and surface three ways:
+
+  * `GET /kang/traces` on the debug HTTP server (NDJSON, one span per
+    line — see http_server.py);
+  * the SIGUSR2 dump (`debug.dump_fsm_histories()` folds in the slowest
+    claims next to the FSM histories);
+  * histograms / counters / gauges on an attached metrics Collector,
+    served through the existing `/metrics` endpoint.
+
+Zero dependencies and hot-path neutral when disabled: the only cost a
+disabled tracer adds to the claim cycle is a module-global load plus a
+None check (the same discipline as the pool's empty-tuple telemetry
+walk), guarded by the bench A/B stage (`bench.py --host-only`) and
+`tests/test_bench_guard.py`.
+
+All span timestamps are monotonic milliseconds (`utils.current_millis`),
+the same clock as `ch_started` and the FSM history ring — durations are
+exact; absolute values are process-relative, not wall-clock.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import random
+
+from . import utils as mod_utils
+
+DEFAULT_RING_SIZE = 512
+
+# Histograms the runtime feeds from completed spans (all milliseconds).
+TRACE_HISTOGRAMS = {
+    'cueball_claim_wait_ms':
+        'Time a claim spent queued before a slot was assigned (ms)',
+    'cueball_connect_ms':
+        'TCP connect + constructor time per backend connect (ms)',
+    'cueball_handshake_ms':
+        'Slot claim handshake time, claiming to claimed (ms)',
+    'cueball_lease_held_ms':
+        'Time a claimed connection was held before release (ms)',
+    'cueball_dns_lookup_ms':
+        'DNS lookup round-trip time (ms)',
+}
+
+SHED_COUNTER = 'cueball_codel_shed_total'
+SHED_HELP = 'Claims shed by CoDel admission control, by reason'
+
+# Per-pool gauges refreshed lazily at scrape time from the same
+# mark_dirty() hooks that drive the fleet sampler's TelemetryRowHandle.
+POOL_GAUGES = {
+    'cueball_queue_depth': 'Claims waiting in the pool claim queue',
+    'cueball_open_slots': 'Connection slots open (all states)',
+    'cueball_idle_slots': 'Connection slots idle (claimable)',
+    'cueball_busy_slots': 'Connection slots busy (claimed)',
+    'cueball_pending_slots': 'Connection slots still connecting',
+}
+
+
+def _new_trace_id() -> str:
+    return '%032x' % random.getrandbits(128)
+
+
+def _new_span_id() -> str:
+    return '%016x' % random.getrandbits(64)
+
+
+class Span:
+    """One timed operation. `end is None` means still open; event spans
+    are recorded with end == start."""
+
+    __slots__ = ('name', 'span_id', 'parent_span_id', 'start', 'end',
+                 'attrs')
+
+    def __init__(self, name: str, parent_span_id: str | None,
+                 start: float, attrs: dict | None = None):
+        self.name = name
+        self.span_id = _new_span_id()
+        self.parent_span_id = parent_span_id
+        self.start = start
+        self.end = None
+        self.attrs = dict(attrs or {})
+
+    def duration(self) -> float | None:
+        if self.end is None:
+            return None
+        return self.end - self.start
+
+
+class Trace:
+    """A flat span list sharing one trace_id; spans[0] is the root."""
+
+    __slots__ = ('trace_id', 'spans', 'tr_runtime')
+
+    root_name = 'trace'
+
+    def __init__(self, runtime: '_TraceRuntime', attrs: dict | None = None,
+                 start: float | None = None):
+        self.trace_id = _new_trace_id()
+        self.tr_runtime = runtime
+        if start is None:
+            start = mod_utils.current_millis()
+        self.spans = [Span(self.root_name, None, start, attrs)]
+
+    @property
+    def root(self) -> Span:
+        return self.spans[0]
+
+    def begin_span(self, name: str, attrs: dict | None = None,
+                   start: float | None = None) -> Span:
+        if start is None:
+            start = mod_utils.current_millis()
+        span = Span(name, self.root.span_id, start, attrs)
+        self.spans.append(span)
+        return span
+
+    def end_span(self, span: Span, end: float | None = None) -> None:
+        if span.end is None:
+            span.end = mod_utils.current_millis() if end is None else end
+
+    def add_event(self, name: str, attrs: dict | None = None) -> Span:
+        """A zero-duration decision/event span (end == start)."""
+        span = self.begin_span(name, attrs)
+        span.end = span.start
+        return span
+
+    def span_totals(self) -> dict:
+        """Sum of closed-span durations per span name (ms)."""
+        totals: dict = {}
+        for span in self.spans[1:]:
+            d = span.duration()
+            if d is not None:
+                totals[span.name] = totals.get(span.name, 0.0) + d
+        return totals
+
+    def finish(self, outcome: str, end: float | None = None) -> None:
+        """Close the root span and hand the trace to the ring; safe to
+        call more than once (terminal FSM states can chain, e.g.
+        released -> closed)."""
+        root = self.root
+        if root.end is not None:
+            return
+        root.attrs['outcome'] = outcome
+        root.end = mod_utils.current_millis() if end is None else end
+        for span in self.spans[1:]:
+            if span.end is None:
+                span.end = root.end
+        self.tr_runtime.completed(self)
+
+    def ndjson_lines(self) -> list:
+        out = []
+        for span in self.spans:
+            out.append(json.dumps({
+                'trace_id': self.trace_id,
+                'span_id': span.span_id,
+                'parent_span_id': span.parent_span_id,
+                'name': span.name,
+                'start': span.start,
+                'end': span.end,
+                'attrs': span.attrs,
+            }, sort_keys=True))
+        return out
+
+
+class ClaimTrace(Trace):
+    """Spans for one pool/set claim. The claim handle calls exactly one
+    guarded method per FSM transition; every method tolerates arriving
+    in unexpected orders (terminal states finish idempotently)."""
+
+    __slots__ = ('ct_queue_span', 'ct_handshake_span', 'ct_lease_span')
+
+    root_name = 'claim'
+
+    def __init__(self, runtime: '_TraceRuntime', pool,
+                 start: float | None = None):
+        # 'pool' may be a ConnectionPool or a ConnectionSet standing in
+        # as one (cset claims hand the set itself down), so everything
+        # here is getattr-guarded.
+        uuid = getattr(pool, 'p_uuid', None) or \
+            getattr(pool, 'cs_uuid', None) or ''
+        domain = getattr(pool, 'p_domain', None) or \
+            getattr(pool, 'cs_domain', None) or ''
+        Trace.__init__(self, runtime, {
+            'kind': 'claim',
+            'pool': str(uuid),
+            'domain': str(domain),
+        }, start=start)
+        self.ct_queue_span = self.begin_span('queue_wait',
+                                             start=self.root.start)
+        self.ct_handshake_span = None
+        self.ct_lease_span = None
+
+    def codel_decision(self, decision: str, sojourn_ms: float,
+                       target_ms: float) -> None:
+        self.add_event('codel', {
+            'decision': decision,
+            'sojourn_ms': round(float(sojourn_ms), 3),
+            'target_ms': float(target_ms),
+        })
+
+    def slot_selected(self, source: str) -> None:
+        self.add_event('slot_select', {'source': source})
+
+    def claiming(self, slot) -> None:
+        """Queue wait is over; the claim handshake with `slot` begins.
+        The serving slot's last connect is attached as a child span so
+        the trace shows where connect time went even when the connect
+        predates the claim (attrs.during_claim says which)."""
+        now = mod_utils.current_millis()
+        self.end_span(self.ct_queue_span, now)
+        backend = ''
+        smgr = None
+        get_smgr = getattr(slot, 'get_socket_mgr', None)
+        if get_smgr is not None:
+            smgr = get_smgr()
+        if smgr is not None:
+            be = getattr(smgr, 'sm_backend', None) or {}
+            backend = str(be.get('key') or '')
+            last = getattr(smgr, 'sm_last_connect', None)
+            if last is not None:
+                cstart, cend = last
+                span = Span('connect', self.root.span_id, cstart,
+                            {'backend': backend,
+                             'during_claim': cend >= self.root.start})
+                span.end = cend
+                self.spans.append(span)
+        self.ct_handshake_span = self.begin_span(
+            'handshake', {'backend': backend}, start=now)
+
+    def claimed(self) -> None:
+        now = mod_utils.current_millis()
+        if self.ct_handshake_span is not None:
+            self.end_span(self.ct_handshake_span, now)
+        self.ct_lease_span = self.begin_span('lease', start=now)
+
+    def requeued(self) -> None:
+        """The slot rejected the handshake; the claim is back in the
+        queue. Only meaningful when a handshake was open."""
+        if self.ct_handshake_span is None:
+            return
+        now = mod_utils.current_millis()
+        if self.ct_handshake_span.end is None:
+            self.ct_handshake_span.attrs['outcome'] = 'rejected'
+            self.end_span(self.ct_handshake_span, now)
+        self.ct_handshake_span = None
+        self.add_event('requeue')
+        self.ct_queue_span = self.begin_span(
+            'queue_wait', {'requeue': True}, start=now)
+
+    def released(self, how: str) -> None:
+        now = mod_utils.current_millis()
+        if self.ct_lease_span is not None:
+            self.end_span(self.ct_lease_span, now)
+        if self.root.end is None:
+            self.add_event('release', {'how': how})
+        self.finish('released' if how == 'release' else 'closed',
+                    end=now)
+
+    def failed(self, err) -> None:
+        if err is not None:
+            self.root.attrs['error'] = type(err).__name__
+        self.finish('failed')
+
+    def cancelled(self) -> None:
+        self.finish('cancelled')
+
+
+class DnsTrace(Trace):
+    """Spans for one DNS resolution: a root lookup span plus one
+    `dns_query` child per resolver attempt (dns_client)."""
+
+    __slots__ = ()
+
+    root_name = 'dns_lookup'
+
+    def __init__(self, runtime: '_TraceRuntime', domain: str, rtype: str):
+        Trace.__init__(self, runtime, {
+            'kind': 'dns',
+            'domain': str(domain),
+            'type': str(rtype),
+        })
+
+    def query_begin(self, resolver: str) -> Span:
+        return self.begin_span('dns_query', {'resolver': str(resolver)})
+
+    def query_end(self, span: Span, outcome: str) -> None:
+        span.attrs['outcome'] = outcome
+        self.end_span(span)
+
+    def done(self, outcome: str, err=None) -> None:
+        if err is not None:
+            self.root.attrs['error'] = type(err).__name__
+        self.finish(outcome)
+
+
+class _GaugeRow:
+    """Speaks the pool's telemetry_attach protocol (the same hooks that
+    drive the fleet sampler's TelemetryRowHandle): the pool marks the
+    row dirty on every state-moving event, and the runtime re-reads the
+    pool's gauges only on scrapes where something changed."""
+
+    __slots__ = ('gr_pool', 'gr_labels', 'gr_dirty')
+
+    def __init__(self, pool, labels: dict):
+        self.gr_pool = pool
+        self.gr_labels = labels
+        self.gr_dirty = True
+
+    def mark_dirty(self) -> None:
+        self.gr_dirty = True
+
+
+class _TraceRuntime:
+    """Process-global tracer state: the completed-trace ring, the
+    sampling decision, and the optional metric aggregation."""
+
+    def __init__(self, ring_size: int = DEFAULT_RING_SIZE,
+                 sample_rate: float = 1.0, collector=None):
+        if ring_size < 1:
+            raise ValueError('ring_size must be >= 1')
+        if not 0.0 <= sample_rate <= 1.0:
+            raise ValueError('sample_rate must be within [0, 1]')
+        self.tr_ring: collections.deque = collections.deque(
+            maxlen=int(ring_size))
+        self.tr_sample = float(sample_rate)
+        self.tr_collector = collector
+        self.tr_seen = 0
+        self.tr_sampled = 0
+        self.tr_rows: dict = {}
+        self.tr_generation = None
+        if collector is not None:
+            for name, help_ in TRACE_HISTOGRAMS.items():
+                collector.histogram(name, help=help_)
+            collector.counter(SHED_COUNTER, help=SHED_HELP)
+            for name, help_ in POOL_GAUGES.items():
+                collector.gauge(name, help=help_)
+            collector.add_collect_hook(self.refresh_gauges)
+
+    # -- sampling ---------------------------------------------------------
+
+    def _sampled(self) -> bool:
+        self.tr_seen += 1
+        rate = self.tr_sample
+        if rate >= 1.0:
+            sampled = True
+        elif rate <= 0.0:
+            sampled = False
+        else:
+            sampled = random.random() < rate
+        if sampled:
+            self.tr_sampled += 1
+        return sampled
+
+    # -- claim-path hooks (called from pool / connection_fsm / cset) ------
+
+    def claim_begin(self, handle, pool) -> None:
+        if self._sampled():
+            handle.ch_trace = ClaimTrace(
+                self, pool, start=getattr(handle, 'ch_started', None))
+
+    def connect_done(self, backend_key, start: float, end: float) -> None:
+        self.observe('cueball_connect_ms', end - start)
+
+    def codel_shed(self, handle, reason: str, sojourn_ms: float,
+                   target_ms: float) -> None:
+        if self.tr_collector is not None:
+            self.tr_collector.counter(SHED_COUNTER, help=SHED_HELP) \
+                .increment({'reason': reason})
+        trace = getattr(handle, 'ch_trace', None)
+        if trace is not None:
+            trace.codel_decision('shed-' + reason, sojourn_ms, target_ms)
+
+    def dns_begin(self, domain: str, rtype: str) -> DnsTrace | None:
+        if not self._sampled():
+            return None
+        return DnsTrace(self, domain, rtype)
+
+    def observe(self, name: str, value_ms: float) -> None:
+        if self.tr_collector is not None and value_ms is not None:
+            self.tr_collector.histogram(
+                name, help=TRACE_HISTOGRAMS.get(name, '')) \
+                .observe(value_ms)
+
+    # -- completion -------------------------------------------------------
+
+    def completed(self, trace: Trace) -> None:
+        self.tr_ring.append(trace)
+        if self.tr_collector is None:
+            return
+        totals = trace.span_totals()
+        if isinstance(trace, ClaimTrace):
+            if 'queue_wait' in totals:
+                self.observe('cueball_claim_wait_ms', totals['queue_wait'])
+            if 'handshake' in totals:
+                self.observe('cueball_handshake_ms', totals['handshake'])
+            if 'lease' in totals:
+                self.observe('cueball_lease_held_ms', totals['lease'])
+        elif isinstance(trace, DnsTrace):
+            self.observe('cueball_dns_lookup_ms', trace.root.duration())
+
+    # -- per-pool gauges --------------------------------------------------
+
+    def refresh_gauges(self) -> None:
+        """Collect-time refresh: reconcile the pool roster (via the
+        monitor's generation counter, as the sampler does), then re-read
+        gauges only for pools whose telemetry row was marked dirty."""
+        if self.tr_collector is None:
+            return
+        from . import monitor as mod_monitor
+        mon = mod_monitor.pool_monitor
+        gen = mon.pm_generation
+        if gen != self.tr_generation:
+            self.tr_generation = gen
+            live = dict(mon.pm_pools)
+            for uuid in list(self.tr_rows):
+                if uuid not in live:
+                    self._drop_row(uuid)
+            for uuid, pool in live.items():
+                if uuid in self.tr_rows:
+                    continue
+                if getattr(pool, 'telemetry_attach', None) is None:
+                    continue
+                row = _GaugeRow(pool, {
+                    'pool': str(uuid),
+                    'domain': str(getattr(pool, 'p_domain', '')),
+                })
+                self.tr_rows[uuid] = row
+                pool.telemetry_attach(row)
+        for row in self.tr_rows.values():
+            if not row.gr_dirty:
+                continue
+            row.gr_dirty = False
+            stats = row.gr_pool.get_stats()
+            total = stats['totalConnections']
+            idle = stats['idleConnections']
+            pending = stats['pendingConnections']
+            values = {
+                'cueball_queue_depth': stats['waiterCount'],
+                'cueball_open_slots': total,
+                'cueball_idle_slots': idle,
+                'cueball_busy_slots': max(total - idle - pending, 0),
+                'cueball_pending_slots': pending,
+            }
+            for name, v in values.items():
+                self.tr_collector.gauge(
+                    name, help=POOL_GAUGES[name]).set(v, row.gr_labels)
+
+    def _drop_row(self, uuid) -> None:
+        row = self.tr_rows.pop(uuid, None)
+        if row is None:
+            return
+        detach = getattr(row.gr_pool, 'telemetry_detach', None)
+        if detach is not None:
+            detach(row)
+        for name in POOL_GAUGES:
+            self.tr_collector.gauge(
+                name, help=POOL_GAUGES[name]).remove(row.gr_labels)
+
+    def shutdown(self) -> None:
+        for uuid in list(self.tr_rows):
+            self._drop_row(uuid)
+        if self.tr_collector is not None:
+            self.tr_collector.remove_collect_hook(self.refresh_gauges)
+
+
+# The one per-process runtime; None when tracing is off. Hot-path call
+# sites read this module global directly and branch on None — keep it a
+# simple attribute so the disabled cost stays one load + one check.
+_runtime: _TraceRuntime | None = None
+
+
+def enable_tracing(ring_size: int = DEFAULT_RING_SIZE,
+                   sample_rate: float = 1.0,
+                   collector=None) -> _TraceRuntime:
+    """Turn on claim-path tracing process-wide. `collector` (a
+    metrics.Collector) is optional: without one, traces land in the
+    ring and on /kang/traces but no histograms/gauges are fed."""
+    global _runtime
+    if _runtime is not None:
+        disable_tracing()
+    _runtime = _TraceRuntime(ring_size, sample_rate, collector)
+    return _runtime
+
+
+def disable_tracing() -> None:
+    """Turn tracing off and detach every pool hook it installed."""
+    global _runtime
+    runtime = _runtime
+    _runtime = None
+    if runtime is not None:
+        runtime.shutdown()
+
+
+def tracing_enabled() -> bool:
+    return _runtime is not None
+
+
+def active_collector():
+    """The enabled runtime's Collector (or None): lets other metric
+    producers (e.g. the fleet sampler) publish onto the same canonical
+    surface without plumbing a collector of their own."""
+    runtime = _runtime
+    return runtime.tr_collector if runtime is not None else None
+
+
+def trace_ring() -> list:
+    """Completed traces, oldest first (a copy; safe to iterate)."""
+    runtime = _runtime
+    return list(runtime.tr_ring) if runtime is not None else []
+
+
+def export_ndjson() -> str:
+    """All ring spans as NDJSON, one span per line, oldest trace first
+    (the /kang/traces payload). Empty string when tracing is off."""
+    runtime = _runtime
+    if runtime is None:
+        return ''
+    lines: list = []
+    for trace in runtime.tr_ring:
+        lines.extend(trace.ndjson_lines())
+    return '\n'.join(lines) + '\n' if lines else ''
+
+
+def summary() -> dict:
+    runtime = _runtime
+    if runtime is None:
+        return {'enabled': False}
+    return {
+        'enabled': True,
+        'ring': len(runtime.tr_ring),
+        'ring_size': runtime.tr_ring.maxlen,
+        'sample_rate': runtime.tr_sample,
+        'seen': runtime.tr_seen,
+        'sampled': runtime.tr_sampled,
+    }
+
+
+def dump_traces(limit: int = 8) -> str:
+    """Human-oriented section for the SIGUSR2 dump: the `limit` slowest
+    completed traces with their per-span breakdown. '' when tracing is
+    off or the ring is empty."""
+    runtime = _runtime
+    if runtime is None or not runtime.tr_ring:
+        return ''
+    traces = sorted(runtime.tr_ring,
+                    key=lambda t: t.root.duration() or 0.0,
+                    reverse=True)[:limit]
+    out = ['-- claim traces (%d slowest of %d in ring; '
+           'sample_rate=%g) --' %
+           (len(traces), len(runtime.tr_ring), runtime.tr_sample)]
+    for trace in traces:
+        root = trace.root
+        parts = ['%s=%.1f' % (name, ms)
+                 for name, ms in sorted(trace.span_totals().items())]
+        out.append('  %s %-10s %8.1fms %-9s %s' % (
+            trace.trace_id[:8], root.name, root.duration() or 0.0,
+            root.attrs.get('outcome', '?'), ' '.join(parts)))
+    return '\n'.join(out) + '\n'

@@ -140,6 +140,11 @@ def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
     async def fake_queued():
         return (50.0, 1.0)
 
+    async def fake_tracing_ab():
+        return {'off_pre_ops_per_sec': 100.0, 'on_ops_per_sec': 99.0,
+                'off_post_ops_per_sec': 100.0,
+                'tracing_on_overhead_pct': 1.0}
+
     def boom(*a, **kw):
         raise AssertionError('chip stage must not run under host_only')
 
@@ -147,6 +152,7 @@ def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
     monkeypatch.setattr(bench, 'bench_claim_throughput', fake_claim)
     monkeypatch.setattr(bench, 'bench_queued_claim_throughput',
                         fake_queued)
+    monkeypatch.setattr(bench, 'bench_tracing_ab', fake_tracing_ab)
     monkeypatch.setattr(bench, 'bench_sampler_tick_host',
                         lambda: {'tick_us_64': 10.0, 'gather_us_64': 5.0})
     monkeypatch.setattr(bench, 'bench_telemetry_step_guarded', boom)
@@ -161,5 +167,36 @@ def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
     assert result['value'] == 2.5
     assert result['claim_release_ops_per_sec'] == 100.0
     assert result['sampler_tick_host_us'] == {'64': 10.0}
+    assert result['claim_tracing_ab']['tracing_on_overhead_pct'] == 1.0
     assert result['telemetry_pools_per_sec'] is None
     assert 'telemetry_error' not in result
+
+
+def test_tracing_off_overhead_within_noise():
+    """The A/B-neutrality contract from the tracing work: with tracing
+    DISABLED the claim path carries exactly one module-global load and
+    None check per claim, so the two disabled arms of the A/B (one run
+    before an enabled arm, one after) must agree to within the noise
+    floor. A drift here means the tracer leaked state past
+    disable_tracing() or the disabled branch grew real work."""
+    import asyncio
+
+    from cueball_tpu import trace as mod_trace
+
+    ab = asyncio.run(bench.bench_tracing_ab(ops=1500, trials=3))
+    # The enabled arm must not leak a runtime into the process.
+    assert not mod_trace.tracing_enabled()
+    off_pre = ab['off_pre_ops_per_sec']
+    off_post = ab['off_post_ops_per_sec']
+    assert off_pre > 0 and off_post > 0
+    # Noise envelope: 3 sigma of the two disabled arms, floored at 25%
+    # of the pre rate so a shared/overcommitted CI host cannot flake
+    # the gate (the real regression this guards — a disabled branch
+    # doing per-claim work — costs far more than 25%).
+    envelope = max(3.0 * (ab['off_pre_stdev'] + ab['off_post_stdev']),
+                   0.25 * off_pre)
+    assert abs(off_post - off_pre) <= envelope, ab
+    # The enabled arm actually traced: its cost is recorded, and the
+    # protocol string documents the interleaving for the JSON reader.
+    assert ab['on_ops_per_sec'] > 0
+    assert 'interleaved' in ab['protocol']

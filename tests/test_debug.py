@@ -217,6 +217,58 @@ def test_init_from_env_bad_signal_logs_and_continues(caplog):
     assert any('not installed' in r.getMessage() for r in caplog.records)
 
 
+def test_signal_dump_includes_trace_ring(caplog):
+    """With tracing enabled, the SIGUSR2 dump shows the last slow
+    claims next to the FSM states (the 'where did latency go' half of
+    the live-attach story)."""
+    async def t():
+        from cueball_tpu import trace as mod_trace
+        pool, res = build_pool()
+        await settle(pool)
+        mod_trace.enable_tracing()
+        prev = cb.install_debug_handler(signal.SIGUSR2)
+        try:
+            hdl, conn = await pool.claim()
+            hdl.release()
+            await asyncio.sleep(0.02)
+            with caplog.at_level(logging.WARNING, logger='cueball.debug'):
+                os.kill(os.getpid(), signal.SIGUSR2)
+                await asyncio.sleep(0.05)
+        finally:
+            mod_debug.uninstall_debug_handler(prev, signal.SIGUSR2)
+            mod_utils.disable_stack_traces()
+            mod_trace.disable_tracing()
+        dump = next(r.getMessage() for r in caplog.records
+                    if 'debug signal' in r.getMessage())
+        # FSM states and the trace section ride the same dump.
+        assert 'domain=debug.test' in dump
+        assert '-- claim traces' in dump
+        assert re.search(r'claim\s+\d+\.\dms\s+released', dump)
+        pool.stop()
+    run_async(t())
+
+
+def test_signal_dump_defers_to_running_loop(caplog):
+    """With an asyncio loop running, _on_debug_signal must NOT dump
+    inline (buffered log writes are not reentrancy-safe at interrupt
+    points): the toggle lands synchronously, the dump only after the
+    loop runs its call_soon_threadsafe callbacks."""
+    async def t():
+        assert not mod_utils.stack_traces_enabled()
+        try:
+            with caplog.at_level(logging.WARNING, logger='cueball.debug'):
+                mod_debug._on_debug_signal(signal.SIGUSR2, None)
+                assert mod_utils.stack_traces_enabled()
+                assert not any('debug signal' in r.getMessage()
+                               for r in caplog.records)
+                await asyncio.sleep(0.05)
+                assert any('debug signal' in r.getMessage()
+                           for r in caplog.records)
+        finally:
+            mod_utils.disable_stack_traces()
+    run_async(t())
+
+
 def test_fsm_line_survives_broken_objects():
     class Broken:
         def get_state(self):

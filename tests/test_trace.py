@@ -1,0 +1,366 @@
+"""Claim-path span tracing (trace.py) and the canonical metric surface
+(metrics.py): the end-to-end acceptance test drives a real pool claim
+and asserts the SAME trace is visible on all three export surfaces —
+GET /kang/traces (OTLP-field NDJSON), the SIGUSR2 dump, and /metrics
+histograms + per-pool gauges — plus unit coverage for sampling, the
+ring bound, CoDel shed accounting, DNS spans, exposition-format
+escaping and metric-type-mismatch errors."""
+
+import asyncio
+import json
+import re
+
+import pytest
+
+import cueball_tpu as cb
+from cueball_tpu import metrics as mod_metrics
+from cueball_tpu import trace as mod_trace
+from cueball_tpu.http_server import serve_monitor
+
+from conftest import run_async
+from test_debug import build_pool, settle
+from test_monitor import _get
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    """Tracing is process-global state: never leak it across tests."""
+    yield
+    mod_trace.disable_tracing()
+
+
+class DummyPool:
+    p_uuid = 'pool-uuid'
+    p_domain = 'dummy.example'
+
+
+class DummyHandle:
+    ch_trace = None
+    ch_started = None
+
+
+def test_claim_trace_end_to_end():
+    async def t():
+        coll = mod_metrics.create_collector({'component': 'cueball'})
+        mod_trace.enable_tracing(ring_size=64, sample_rate=1.0,
+                                 collector=coll)
+        pool, res = build_pool()
+        await settle(pool)
+        server = await serve_monitor(collector=coll)
+        port = server.sockets[0].getsockname()[1]
+
+        hdl, conn = await pool.claim({'timeout': 1000})
+        await asyncio.sleep(0.02)    # hold the lease a measurable time
+        hdl.release()
+        await asyncio.sleep(0.02)
+
+        # (1) The ring holds the completed ClaimTrace with every span
+        # of the claim's life.
+        claims = [tr for tr in cb.trace_ring()
+                  if tr.root.name == 'claim']
+        assert claims
+        tr = claims[-1]
+        assert tr.root.attrs['outcome'] == 'released'
+        assert tr.root.attrs['domain'] == 'debug.test'
+        names = [s.name for s in tr.spans]
+        for want in ('claim', 'queue_wait', 'slot_select', 'connect',
+                     'handshake', 'lease', 'release'):
+            assert want in names, names
+        assert tr.span_totals()['lease'] >= 10.0
+
+        # (2) GET /kang/traces serves the ring as NDJSON with
+        # OTLP-compatible field names.
+        status, text = await _get(port, '/kang/traces')
+        assert status == 200
+        spans = [json.loads(line) for line in text.splitlines()]
+        assert spans
+        for s in spans:
+            assert set(s) == {'trace_id', 'span_id', 'parent_span_id',
+                              'name', 'start', 'end', 'attrs'}
+        assert re.fullmatch(r'[0-9a-f]{32}', tr.trace_id)
+        mine = [s for s in spans if s['trace_id'] == tr.trace_id]
+        roots = [s for s in mine if s['parent_span_id'] is None]
+        assert len(roots) == 1 and roots[0]['name'] == 'claim'
+        children = {s['name'] for s in mine
+                    if s['parent_span_id'] == roots[0]['span_id']}
+        assert {'queue_wait', 'handshake', 'lease'} <= children
+
+        # (3) The SIGUSR2 dump folds in the slowest claims.
+        report = cb.dump_fsm_histories()
+        assert '-- claim traces' in report
+        assert tr.trace_id[:8] in report
+
+        # (4) /metrics carries nonzero histogram observations and the
+        # per-pool gauges (refreshed by the scrape-time hook).
+        status, text = await _get(port, '/metrics')
+        assert status == 200
+        assert '# TYPE cueball_claim_wait_ms histogram' in text
+        for name in ('cueball_claim_wait_ms', 'cueball_connect_ms',
+                     'cueball_handshake_ms', 'cueball_lease_held_ms'):
+            m = re.search(r'%s_count(?:{[^}]*})? (\d+)' % name, text)
+            assert m and int(m.group(1)) >= 1, name
+        m = re.search(r'cueball_open_slots{[^}]*pool="%s"[^}]*} (\d+)'
+                      % pool.p_uuid, text)
+        assert m and int(m.group(1)) >= 1
+        assert 'cueball_queue_depth{' in text
+        assert 'cueball_idle_slots{' in text
+
+        # (5) The kang snapshot summarizes the ring.
+        status, snap = await _get(port, '/kang/snapshot')
+        assert snap['traces']['enabled'] is True
+        assert snap['traces']['ring'] >= 1
+        assert snap['traces']['sampled'] >= 1
+
+        server.close()
+        pool.stop()
+    run_async(t())
+
+
+def test_sampling_zero_records_nothing():
+    rt = mod_trace.enable_tracing(ring_size=4, sample_rate=0.0)
+    h = DummyHandle()
+    rt.claim_begin(h, DummyPool())
+    assert h.ch_trace is None
+    assert rt.tr_seen == 1 and rt.tr_sampled == 0
+    assert mod_trace.export_ndjson() == ''
+    s = mod_trace.summary()
+    assert s['enabled'] is True
+    assert s['seen'] == 1 and s['sampled'] == 0 and s['ring'] == 0
+
+
+def test_ring_is_bounded_oldest_dropped():
+    rt = mod_trace.enable_tracing(ring_size=4, sample_rate=1.0)
+    ids = []
+    for _ in range(7):
+        tr = mod_trace.ClaimTrace(rt, DummyPool())
+        tr.claimed()
+        tr.released('release')
+        ids.append(tr.trace_id)
+    ring = mod_trace.trace_ring()
+    assert len(ring) == 4
+    assert [tr.trace_id for tr in ring] == ids[-4:]
+
+
+def test_bad_knobs_rejected():
+    with pytest.raises(ValueError):
+        mod_trace._TraceRuntime(ring_size=0)
+    with pytest.raises(ValueError):
+        mod_trace._TraceRuntime(sample_rate=1.5)
+    with pytest.raises(ValueError):
+        mod_trace._TraceRuntime(sample_rate=-0.1)
+
+
+def test_disabled_surfaces_are_empty():
+    mod_trace.disable_tracing()
+    assert not mod_trace.tracing_enabled()
+    assert mod_trace.trace_ring() == []
+    assert mod_trace.export_ndjson() == ''
+    assert mod_trace.dump_traces() == ''
+    assert mod_trace.summary() == {'enabled': False}
+    assert mod_trace.active_collector() is None
+
+
+def test_ndjson_structure_and_idempotent_finish():
+    rt = mod_trace.enable_tracing(ring_size=8)
+    tr = mod_trace.ClaimTrace(rt, DummyPool())
+    tr.claiming(object())      # slot without a socket manager: fine
+    tr.claimed()
+    tr.released('close')
+    tr.released('release')     # terminal states can chain: first wins
+    assert tr.root.attrs['outcome'] == 'closed'
+    assert len(mod_trace.trace_ring()) == 1
+    out = mod_trace.export_ndjson()
+    assert out.endswith('\n')
+    spans = [json.loads(line) for line in out.splitlines()]
+    root = spans[0]
+    assert root['parent_span_id'] is None
+    assert re.fullmatch(r'[0-9a-f]{32}', root['trace_id'])
+    assert re.fullmatch(r'[0-9a-f]{16}', root['span_id'])
+    for s in spans[1:]:
+        assert s['trace_id'] == root['trace_id']
+        assert s['parent_span_id'] == root['span_id']
+        assert s['end'] >= s['start']
+
+
+def test_codel_paced_shed_counted_and_traced():
+    """White-box pacer drive: put the pacer in established shave mode
+    with a live dequeue clock and a far-over-target head waiter, then
+    run one pacer tick — the shed must increment
+    cueball_codel_shed_total{reason="paced"} and stamp the waiter's
+    trace with the decision."""
+    async def t():
+        from cueball_tpu.utils import current_millis
+        coll = mod_metrics.create_collector()
+        mod_trace.enable_tracing(collector=coll)
+        pool, res = build_pool(targetClaimDelay=40, spares=1, maximum=1)
+        await settle(pool)
+        hdl, conn = await pool.claim()   # occupy the only slot
+        shed = []
+        pool.claim_cb({}, lambda err, h=None, c=None: shed.append(err))
+        await asyncio.sleep(0.01)
+        assert len(pool.p_waiters) == 1
+        waiter = pool.p_waiters.peek()
+        assert waiter.ch_trace is not None
+        now = current_millis()
+        waiter.ch_started = now - 500
+        pool.p_last_dequeue = now - 5       # service looks live
+        pool.p_pace_above_since = now - 200  # over target > interval
+        pool.p_pace_shaving = True
+        pool._codel_pace()
+        await asyncio.sleep(0.02)
+        assert shed and shed[0] is not None
+        c = coll.counter(mod_trace.SHED_COUNTER)
+        assert c.value({'reason': 'paced'}) == 1
+        events = [s for tr in cb.trace_ring() for s in tr.spans
+                  if s.name == 'codel']
+        assert any(s.attrs['decision'] == 'shed-paced' for s in events)
+        hdl.release()
+        pool.stop()
+    run_async(t())
+
+
+def test_dns_resolver_traces_lookups():
+    async def t():
+        import sys
+        sys.path.insert(0, 'tests')
+        from fake_dns import FakeDnsClient
+        from cueball_tpu import dns_resolver as mod_dns
+        from conftest import wait_for_state
+        coll = mod_metrics.create_collector()
+        mod_trace.enable_tracing(collector=coll)
+        orig = mod_dns.have_global_v6
+        mod_dns.have_global_v6 = lambda: False
+        try:
+            res = cb.DNSResolver({
+                'domain': 'a.ok', 'service': '_foo._tcp',
+                'resolvers': ['1.2.3.4'],
+                'recovery': {'default': {'timeout': 1000, 'retries': 2,
+                                         'delay': 100}},
+                'dnsClient': FakeDnsClient()})
+            res.start()
+            await wait_for_state(res, 'running')
+            lookups = [tr for tr in cb.trace_ring()
+                       if tr.root.name == 'dns_lookup']
+            assert lookups
+            assert any(tr.root.attrs.get('outcome') == 'ok'
+                       for tr in lookups)
+            assert {'kind', 'domain', 'type'} <= set(lookups[0].root.attrs)
+            assert coll.histogram('cueball_dns_lookup_ms').count() >= 1
+            res.stop()
+            await wait_for_state(res, 'stopped')
+        finally:
+            mod_dns.have_global_v6 = orig
+    run_async(t())
+
+
+def test_dns_client_per_resolver_query_spans(monkeypatch):
+    """Each resolver attempt inside DnsClient becomes one 'dns_query'
+    child span carrying the attempt's outcome (ok / exception name)."""
+    async def t():
+        from cueball_tpu import dns_client as mod_dc
+        rt = mod_trace.enable_tracing()
+        tr = mod_trace.DnsTrace(rt, 'x.example', 'A')
+
+        async def fake_wire(self, resolver, domain, qtype, timeout_s):
+            if resolver == 'bad':
+                raise mod_dc.DnsTimeoutError(domain)
+            await asyncio.sleep(0.01)
+            return mod_dc.DnsMessage(1, 'NOERROR', False, [
+                {'name': domain, 'type': 'A', 'ttl': 60,
+                 'target': '1.2.3.4', 'port': None}], [], [])
+
+        monkeypatch.setattr(mod_dc.DnsClient, '_query_wire', fake_wire)
+        client = mod_dc.DnsClient(concurrency=2)
+        done = asyncio.Event()
+        out = []
+
+        def cb_(err, msg):
+            out.append((err, msg))
+            done.set()
+
+        client.lookup({'domain': 'x.example', 'type': 'A',
+                       'resolvers': ['bad', 'good'], 'timeout': 1000,
+                       'trace': tr}, cb_)
+        await done.wait()
+        tr.done('ok')
+        assert out[0][0] is None
+        spans = {s.attrs['resolver']: s for s in tr.spans
+                 if s.name == 'dns_query'}
+        assert set(spans) == {'bad', 'good'}
+        assert spans['good'].attrs['outcome'] == 'ok'
+        assert spans['bad'].attrs['outcome'] == 'DnsTimeoutError'
+        assert all(s.end is not None for s in spans.values())
+    run_async(t())
+
+
+def test_disable_tracing_detaches_gauge_rows():
+    async def t():
+        coll = mod_metrics.create_collector()
+        mod_trace.enable_tracing(collector=coll)
+        pool, res = build_pool()
+        await settle(pool)
+        text = coll.collect()    # first scrape attaches the row
+        assert 'pool="%s"' % pool.p_uuid in text
+        rt = mod_trace._runtime
+        row = rt.tr_rows[pool.p_uuid]
+        assert row in pool.p_telemetry
+        mod_trace.disable_tracing()
+        assert row not in pool.p_telemetry
+        # The rows' samples are dropped too: a later scrape of the same
+        # collector must not keep exporting the dead pool's gauges.
+        assert 'pool="%s"' % pool.p_uuid not in coll.collect()
+        pool.stop()
+    run_async(t())
+
+
+# -- metrics.py exposition-format units ------------------------------------
+
+
+def test_label_values_escaped_per_text_format():
+    c = mod_metrics.Counter('evil', help='h')
+    c.increment({'msg': 'a"b\\c\nd'})
+    text = c.serialize()
+    assert 'msg="a\\"b\\\\c\\nd"' in text
+
+
+def test_empty_label_set_renders_without_braces():
+    g = mod_metrics.Gauge('plain', help='h')
+    g.set(3)
+    lines = g.serialize().splitlines()
+    assert 'plain 3' in lines
+    assert all('{}' not in line for line in lines)
+
+
+def test_metric_type_mismatch_raises_typeerror():
+    coll = mod_metrics.create_collector()
+    c = coll.counter('x', help='h')
+    assert coll.counter('x') is c       # same-type re-declare: idempotent
+    with pytest.raises(TypeError, match='already registered'):
+        coll.gauge('x')
+    with pytest.raises(TypeError, match='histogram'):
+        coll.histogram('x')
+    coll.gauge('y')
+    with pytest.raises(TypeError, match='gauge'):
+        coll.counter('y')
+    coll.histogram('z')
+    with pytest.raises(TypeError, match='already registered'):
+        coll.gauge('z')
+
+
+def test_histogram_exposition_format():
+    h = mod_metrics.Histogram('lat_ms', help='h', buckets=(1, 5, 10))
+    h.observe(0.5)
+    h.observe(4)
+    h.observe(100)
+    lines = h.serialize().splitlines()
+    assert lines[0] == '# HELP lat_ms h'
+    assert lines[1] == '# TYPE lat_ms histogram'
+    assert 'lat_ms_bucket{le="1"} 1' in lines
+    assert 'lat_ms_bucket{le="5"} 2' in lines
+    assert 'lat_ms_bucket{le="10"} 2' in lines
+    assert 'lat_ms_bucket{le="+Inf"} 3' in lines
+    assert 'lat_ms_sum 104.5' in lines
+    assert 'lat_ms_count 3' in lines
+    assert h.count() == 3 and h.sum() == 104.5
+    h.remove()
+    assert h.count() == 0
