@@ -337,6 +337,83 @@ async def bench_tracing_ab(ops=TRACING_AB_OPS_PER_TRIAL,
     return out
 
 
+async def bench_pump_ab(ops=CLAIM_OPS_PER_TRIAL, trials=CLAIM_TRIALS):
+    """Pump-off vs pump-on claim-path A/B (the tentpole's receipt).
+
+    Same interleaved three-arm protocol as the tracing A/B — off-pre,
+    on, off-post every round, so host drift lands on all arms equally —
+    at the full claim-bench shape (CLAIM_TRIALS rounds of
+    CLAIM_OPS_PER_TRIAL fixed ops, GC frozen+disabled in the timed
+    sections, single-core affinity inherited from main()). 'off' is
+    the reference's literal scheduling, one loop.call_soon per engine
+    deferral; 'on' coalesces each tick's deferrals into the single
+    pump callback (cueball_tpu/runq.py). Per-arm context-switch deltas
+    ride along so an outlier trial carries its own diagnosis."""
+    import gc
+    import statistics
+    try:
+        import resource
+    except ImportError:
+        resource = None
+    from cueball_tpu import runq
+    build_pool = make_fixture()
+
+    async def one_trial(pump):
+        pool = build_pool()
+        await settle(pool)
+        gc.collect()
+        prev = runq.set_pump_enabled(pump)
+        try:
+            ru0 = resource.getrusage(resource.RUSAGE_SELF) if resource \
+                else None
+            gc.disable()
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                hdl, conn = await pool.claim({'timeout': 1000})
+                hdl.release()
+            elapsed = time.perf_counter() - t0
+            gc.enable()
+            ru1 = resource.getrusage(resource.RUSAGE_SELF) if resource \
+                else None
+        finally:
+            runq.set_pump_enabled(prev)
+        pool.stop()
+        while not pool.is_in_state('stopped'):
+            await asyncio.sleep(0.01)
+        diag = {'nvcsw': ru1.ru_nvcsw - ru0.ru_nvcsw,
+                'nivcsw': ru1.ru_nivcsw - ru0.ru_nivcsw} if resource \
+            else {}
+        return ops / elapsed, diag
+
+    arms = {'off_pre': [], 'on': [], 'off_post': []}
+    diags = {arm: [] for arm in arms}
+    for trial in range(trials + 1):
+        if trial == 1:
+            gc.collect()
+            gc.freeze()
+        rates = {arm: await one_trial(arm == 'on') for arm in arms}
+        if trial > 0:            # trial 0 is warmup
+            for arm, (rate, diag) in rates.items():
+                arms[arm].append(rate)
+                diags[arm].append(diag)
+
+    out = {}
+    for arm, xs in arms.items():
+        out[arm + '_ops_per_sec'] = round(statistics.mean(xs), 1)
+        out[arm + '_stdev'] = round(
+            statistics.stdev(xs) if len(xs) > 1 else 0.0, 1)
+        out[arm + '_trials'] = [round(r, 1) for r in xs]
+        out[arm + '_trial_diags'] = diags[arm]
+    off = statistics.mean(arms['off_pre'] + arms['off_post'])
+    on = statistics.mean(arms['on'])
+    out['pump_on_gain_pct'] = round(100.0 * (on - off) / off, 2)
+    out['protocol'] = ('%d rounds x %d ops x 3 interleaved arms '
+                       '(off-pre / on / off-post), 1 warmup round, '
+                       'gc frozen+disabled in timed sections, '
+                       'single-core affinity') % (trials, ops)
+    return out
+
+
 def _default_is_pallas():
     """Ask telemetry which FIR path it actually ships here.
 
@@ -542,16 +619,28 @@ def bench_telemetry_stages(emit, pools=TELEM_POOLS):
                       os.environ['CUEBALL_BENCH_TICKS'].split(','))
     emit({'stage': 'tick_sizes', 'tick_sizes': list(sizes)})
     for n in sizes:
-        tick_us, gather_us = _measure_tick_cost(n)
+        tick_us, gather_us, gather_full_us = _measure_tick_cost(n)
         emit({'stage': 'tick_cost_%d' % n,
               'tick_us_%d' % n: tick_us,
-              'gather_us_%d' % n: gather_us})
+              'gather_us_%d' % n: gather_us,
+              'gather_full_us_%d' % n: gather_full_us})
+
+
+GATHER_CHURN = 128   # dirty rows per timed incremental gather
 
 
 def _measure_tick_cost(n: int) -> tuple:
-    """(tick_us, gather_us) for one FleetSampler over n synthetic
-    pools — ONE protocol shared by the chip stage and the host copy,
-    so the two numbers always measure the same thing."""
+    """(tick_us, gather_us, gather_full_us) for one FleetSampler over
+    n synthetic pools — ONE protocol shared by the chip stage and the
+    host copy, so the numbers always measure the same thing.
+
+    gather_us is the sampler's own incremental host gather
+    (FleetSampler.gather_once over the dirty set) at a FIXED churn of
+    min(GATHER_CHURN, n) marked rows, so the curve across fleet sizes
+    shows how gather cost scales with fleet size at constant event
+    rate — O(dirty) means flat. gather_full_us keeps the old
+    every-pool oracle walk for comparison (the linear curve the
+    incremental path replaced)."""
     from cueball_tpu.monitor import PoolMonitor
     from cueball_tpu.parallel.sampler import FleetSampler
     from cueball_tpu.utils import current_millis
@@ -569,11 +658,26 @@ def _measure_tick_cost(n: int) -> tuple:
             p.set_load(float((p.load + k + 1) % 8))
         s.sample_once()
     tick_us = (time.perf_counter() - t0) / iters * 1e6
+
+    # Incremental gather at constant churn: the same pools go dirty
+    # each round (event dedupe is part of the protocol), stepping
+    # through the fleet so successive rounds touch different rows.
+    churn = min(GATHER_CHURN, n)
+    g_iters = 20
+    stride = max(1, n // churn)
+    t0 = time.perf_counter()
+    for k in range(g_iters):
+        for p in fleet[k % stride::stride][:churn]:
+            p.set_load(float((p.load + 1) % 8))
+        s.gather_once()
+    gather_us = (time.perf_counter() - t0) / g_iters * 1e6
+
     now = current_millis()
     t0 = time.perf_counter()
     for p in fleet:
         FleetSampler.gather_pool(p, now)
-    return tick_us, (time.perf_counter() - t0) * 1e6
+    gather_full_us = (time.perf_counter() - t0) * 1e6
+    return tick_us, gather_us, gather_full_us
 
 
 def bench_sampler_tick_host(sizes=(1024, 10240)) -> dict:
@@ -599,9 +703,10 @@ def bench_sampler_tick_host(sizes=(1024, 10240)) -> dict:
             return {}
     out = {}
     for n in sizes:
-        tick_us, gather_us = _measure_tick_cost(n)
+        tick_us, gather_us, gather_full_us = _measure_tick_cost(n)
         out['tick_us_%d' % n] = tick_us
         out['gather_us_%d' % n] = gather_us
+        out['gather_full_us_%d' % n] = gather_full_us
     return out
 
 
@@ -670,11 +775,46 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0) -> dict:
     Every stage the child completed before a timeout/crash is read
     back from the progress file, so a wedge loses the remaining
     stages, not the evidence. Returns a flat dict of stage fields plus
-    'stages_completed' and, on failure, 'error'."""
+    'stages_completed' and, on failure, 'error'.
+
+    A cheap backend PROBE runs first (probe_timeout_s): when no
+    accelerator answers at all — tunnel absent rather than wedged
+    mid-run — the stage reports that in seconds instead of sitting
+    out the full run timeout. An explicit JAX_PLATFORMS=cpu request
+    (CI exercising the staged path) skips the probe: the CPU backend
+    is always there."""
     import subprocess
     import sys
     import tempfile
     root = os.path.dirname(os.path.abspath(__file__))
+    if 'cpu' not in (os.environ.get('JAX_PLATFORMS') or ''):
+        probe_timeout_s = 45.0
+        probe = ('import jax; print(jax.default_backend())')
+        try:
+            pr = subprocess.run([sys.executable, '-c', probe],
+                                capture_output=True, text=True,
+                                timeout=probe_timeout_s)
+        except subprocess.TimeoutExpired:
+            err = ('no accelerator: backend probe timed out after %gs '
+                   '(chip tunnel not answering); skipping the chip '
+                   'stage' % probe_timeout_s)
+            print('bench: %s' % err, file=sys.stderr)
+            return {'stages_completed': [], 'error': err}
+        if pr.returncode != 0:
+            err = 'no accelerator: backend probe failed: %s' % (
+                pr.stderr.strip().splitlines()[-1]
+                if pr.stderr.strip() else 'exit %d' % pr.returncode)
+            print('bench: %s' % err, file=sys.stderr)
+            return {'stages_completed': [], 'error': err}
+        if pr.stdout.strip() == 'cpu':
+            # jax came up but only with the host backend: there is no
+            # chip here, and minutes of CPU-run stages would wear a
+            # chip stage's labels. The committed artifact citation
+            # covers the JSON instead (assemble_result).
+            err = ('no accelerator: backend probe answered "cpu"; '
+                   'skipping the chip stage')
+            print('bench: %s' % err, file=sys.stderr)
+            return {'stages_completed': [], 'error': err}
     fd, progress = tempfile.mkstemp(prefix='bench_telem_',
                                     suffix='.jsonl')
     os.close(fd)
@@ -769,7 +909,7 @@ def artifact_citation(root: str | None = None) -> dict:
 
 
 def assemble_result(abs_err, claim, queued, host_tick, telem,
-                    tracing_ab=None) -> dict:
+                    tracing_ab=None, pump_ab=None) -> dict:
     """Build the single JSON-line result from the stage outputs.
 
     Factored out of main() so the guard tests can assert the
@@ -824,9 +964,18 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
         'sampler_tick_host_us': {
             k[len('tick_us_'):]: _r(v) for k, v in host_tick.items()
             if k.startswith('tick_us_')},
+        # Incremental gather (FleetSampler.gather_once over the dirty
+        # set, fixed GATHER_CHURN marked rows): flat across fleet
+        # sizes is the O(dirty) claim.
         'sampler_gather_host_us': {
             k[len('gather_us_'):]: _r(v) for k, v in host_tick.items()
             if k.startswith('gather_us_')},
+        # The old every-pool oracle walk, kept for cross-round
+        # comparison (this is the curve that used to scale linearly).
+        'sampler_gather_full_host_us': {
+            k[len('gather_full_us_'):]: _r(v)
+            for k, v in host_tick.items()
+            if k.startswith('gather_full_us_')},
         'telemetry_stages_completed': telem.get('stages_completed'),
         'telemetry_code_hash': telemetry_code_hash(),
         'device': telem.get('device'),
@@ -834,6 +983,8 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
     }
     if tracing_ab is not None:
         result['claim_tracing_ab'] = tracing_ab
+    if pump_ab is not None:
+        result['claim_pump_ab'] = pump_ab
     if telem.get('error') is not None:
         result['telemetry_error'] = telem['error']
     if telem.get('pools_per_sec_live') is None:
@@ -871,11 +1022,12 @@ async def main(host_only: bool = False):
     claim = await bench_claim_throughput()
     queued = await bench_queued_claim_throughput()
     tracing_ab = await bench_tracing_ab()
+    pump_ab = await bench_pump_ab()
     host_tick = bench_sampler_tick_host()
     telem = {} if host_only else bench_telemetry_step_guarded()
 
     result = assemble_result(abs_err, claim, queued, host_tick, telem,
-                             tracing_ab=tracing_ab)
+                             tracing_ab=tracing_ab, pump_ab=pump_ab)
     if host_only:
         result['host_only'] = True
     print(json.dumps(result))

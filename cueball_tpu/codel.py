@@ -19,6 +19,20 @@ from .utils import current_millis
 
 CODEL_INTERVAL = 100  # ms control interval (reference lib/codel.js:16)
 
+# Pacer cadence (ms) for the pool's continuous-evaluation shave-mode law.
+# Classic CoDel evaluates its control law at every dequeue of a busy
+# queue; a connection pool dequeues only when a connection is released,
+# so with long checkout holds the drop decisions quantize onto the
+# release cadence (plus the 100 ms re-arm interval) and the achieved
+# claim sojourn sits well above targetClaimDelay. While the service
+# process is demonstrably live, the pool runs a shave-mode law between
+# dequeues at this cadence: CoDel's entry condition (head above target
+# for a full control interval), then shed every above-target waiter per
+# tick, with hysteretic exit. ControlledDelay itself is untouched and
+# still consulted at dequeue sites. See docs/internals.md (CoDel
+# section) and Pool._arm_codel_pacer.
+CODEL_PACE = 10
+
 
 class ControlledDelay:
     def __init__(self, target_claim_delay: float):

@@ -36,7 +36,8 @@ from . import errors as mod_errors
 from . import trace as mod_trace
 from . import utils as mod_utils
 from .events import _native
-from .fsm import FSM, get_loop
+from .fsm import FSM
+from .runq import defer
 
 # FSM state-handle gates are framework-internal listeners; the native
 # Gate type carries no attributes, so recognize it by type.
@@ -80,6 +81,28 @@ def count_listeners(emitter, event: str) -> int:
 def _internal(fn):
     fn._cueball_internal = True
     return fn
+
+
+# Events swept by the release leak check (reference
+# lib/connection-fsm.js:786-808 sweeps the same four).
+_LEAK_EVENTS = ('close', 'error', 'readable', 'data')
+
+
+def _listener_epoch(emitter):
+    """External-listener mutation epoch of `emitter`, or None when the
+    emitter doesn't expose one (foreign emitter: always sweep).
+
+    Both engine emitters bump a counter on every *external* listener
+    add/remove (framework gates don't count), so an unchanged epoch
+    proves the leak-check counts cannot have moved and the per-event
+    ``count_listeners`` sweep can be skipped on the claim hot path."""
+    mc = getattr(emitter, 'mutation_count', None)
+    if mc is None:
+        return None
+    try:
+        return mc()
+    except TypeError:
+        return None
 
 
 _STACK_PARSE_CACHE: dict[int, tuple[str, list]] = {}
@@ -427,6 +450,7 @@ class CueBallClaimHandle(FSM):
         self.ch_release_stack: list[str] | None = None
         self.ch_connection = None
         self.ch_pre_listeners: dict[str, int] = {}
+        self.ch_pre_epoch = None    # listener epoch at claim snapshot
         self.ch_cancelled = False
         self.ch_last_error = None
         self._ch_arm_timer = None
@@ -602,7 +626,7 @@ class CueBallClaimHandle(FSM):
             # schedules that first try itself).  Deliberately NOT
             # S.immediate: the requeue must survive leaving 'waiting'
             # (a claim can be handed out before the tick fires).
-            get_loop().call_soon(self.ch_requeue)  # cbfsm: ignore=F006
+            defer(self.ch_requeue)
 
         S.goto_state_on(self, 'tryAsserted', 'claiming')
 
@@ -665,10 +689,26 @@ class CueBallClaimHandle(FSM):
             S.gotoState('released')
             return
 
-        self.ch_pre_listeners = {}
-        for evt in ('close', 'error', 'readable', 'data'):
-            self.ch_pre_listeners[evt] = count_listeners(
-                self.ch_connection, evt)
+        conn = self.ch_connection
+        epoch = _listener_epoch(conn)
+        cached = getattr(conn, '_cueball_listener_counts', None)
+        if epoch is not None and cached is not None and \
+                cached[0] == epoch:
+            # Nobody added/removed an external listener since the last
+            # snapshot: reuse it instead of re-walking four listener
+            # lists per claim (~7% of a claim/release cycle,
+            # docs/claim-path-profile.md round 5).
+            self.ch_pre_listeners = cached[1]
+        else:
+            self.ch_pre_listeners = {
+                evt: count_listeners(conn, evt) for evt in _LEAK_EVENTS}
+            if epoch is not None:
+                try:
+                    conn._cueball_listener_counts = (
+                        epoch, self.ch_pre_listeners)
+                except (AttributeError, TypeError):
+                    pass
+        self.ch_pre_epoch = epoch
 
         @_internal
         def on_error(err=None):
@@ -693,14 +733,29 @@ class CueBallClaimHandle(FSM):
         if not self.ch_do_release_leak_check:
             return
         conn = self.ch_connection
-        for evt in ('close', 'error', 'readable', 'data'):
+        epoch = _listener_epoch(conn)
+        if epoch is not None and epoch == self.ch_pre_epoch:
+            # Zero external listener mutations while claimed: the
+            # counts provably match the claim-time snapshot; skip the
+            # sweep (a leaker necessarily bumps the epoch).
+            return
+        new_counts = {}
+        for evt in _LEAK_EVENTS:
             new_count = count_listeners(conn, evt)
+            new_counts[evt] = new_count
             old_count = self.ch_pre_listeners.get(evt)
             if old_count is not None and new_count > old_count:
                 self.ch_log.warning(
                     'connection claimer looks like it leaked event '
                     'handlers: event=%s before=%d after=%d',
                     evt, old_count, new_count)
+        if epoch is not None:
+            # Refresh the snapshot so the next claim of this
+            # connection can skip its pre-count walk too.
+            try:
+                conn._cueball_listener_counts = (epoch, new_counts)
+            except (AttributeError, TypeError):
+                pass
 
     def state_closed(self, S):
         S.validTransitions([])

@@ -32,8 +32,9 @@ from . import trace as mod_trace
 from . import utils as mod_utils
 from .connection_fsm import ConnectionSlotFSM, CueBallClaimHandle
 from .events import EventEmitter
-from .fsm import FSM, get_loop
+from .fsm import FSM
 from .pool import _Interval
+from .runq import defer
 
 
 class ConnectionSet(FSM):
@@ -271,7 +272,7 @@ class ConnectionSet(FSM):
                         lconn.drain()
                 # Deliberately NOT S.immediate: the drain must still run
                 # if the set reaches 'stopped' before the tick fires.
-                get_loop().call_soon(drain_one)  # cbfsm: ignore=F006
+                defer(drain_one)
 
     def state_stopped(self, S):
         S.validTransitions([])
@@ -350,7 +351,7 @@ class ConnectionSet(FSM):
         if self.cs_rebal_scheduled is not False:
             return
         self.cs_rebal_scheduled = True
-        get_loop().call_soon(self._rebalance)
+        defer(self._rebalance)
 
     def _rebalance(self) -> None:
         """Singleton-mode planning over one-slot-per-backend

@@ -29,12 +29,20 @@ class PyEventEmitter:
 
     def __init__(self) -> None:
         self._ee_listeners: dict[str, list] = {}
+        # External-listener mutation epoch: bumped on every add/remove
+        # of a non-framework listener (FSM gates are marked
+        # `_cueball_internal`). The claim-handle leak detector skips
+        # its per-event count sweep while the epoch is unchanged; the
+        # C core keeps the same counter (emitter.c ee_mutations).
+        self._ee_mut = 0
 
     # -- registration ----------------------------------------------------
 
     def on(self, event: str, listener: typing.Callable) -> typing.Callable:
         """Register listener; returns it so callers can hold a removal ref."""
         self._ee_listeners.setdefault(event, []).append(listener)
+        if not getattr(listener, '_cueball_internal', False):
+            self._ee_mut += 1
         return listener
 
     add_listener = on
@@ -55,17 +63,24 @@ class PyEventEmitter:
         # claim hot path); fall back to the once()-wrapper scan.
         for i, entry in enumerate(lst):
             if entry is listener:
+                if not getattr(entry, '_cueball_internal', False):
+                    self._ee_mut += 1
                 del lst[i]
                 break
         else:
             for i, entry in enumerate(lst):
                 if getattr(entry, '__wrapped_listener__', None) is listener:
+                    if not getattr(entry, '_cueball_internal', False):
+                        self._ee_mut += 1
                     del lst[i]
                     break
         if not lst:
             self._ee_listeners.pop(event, None)
 
     def remove_all_listeners(self, event: str | None = None) -> None:
+        # Conservative bump (may not have removed anything external):
+        # a spurious bump only costs the leak detector one extra sweep.
+        self._ee_mut += 1
         if event is None:
             self._ee_listeners.clear()
         else:
@@ -81,6 +96,10 @@ class PyEventEmitter:
 
     def event_names(self) -> list[str]:
         return [k for k, v in self._ee_listeners.items() if v]
+
+    def mutation_count(self) -> int:
+        """External-listener mutation epoch (see __init__)."""
+        return self._ee_mut
 
     # -- emission --------------------------------------------------------
 

@@ -37,6 +37,7 @@ import time
 import typing
 
 from .events import EventEmitter, _native
+from . import runq
 
 # Module-level transition trace hooks: fn(fsm, old_state, new_state).
 # The dtrace-probe analogue (reference docs/internals.adoc:125-131):
@@ -195,11 +196,12 @@ class _TimerRegistrationsMixin:
         self._add_disposable(cancel)
         return state
 
-    def immediate(self, cb: typing.Callable) -> object:
-        loop = get_loop()
-        handle = loop.call_soon(self._gate(cb))
-        self._add_disposable(handle.cancel)
-        return handle
+    def immediate(self, cb: typing.Callable) -> None:
+        # The gate already makes the callback a no-op once the state is
+        # exited, so the deferral rides the shared engine pump (one
+        # scheduled callback per tick) with no cancel disposable needed.
+        get_loop()  # fail fast with the helpful no-loop message
+        runq.defer(self._gate(cb))
 
     def goto_state_on(self, emitter: EventEmitter, event: str,
                       state: str) -> None:
@@ -382,16 +384,14 @@ class FSM(EventEmitter):
         entry(self, new_handle)
 
         # Async (setImmediate-analogue) stateChanged emission; ordering
-        # across rapid transitions is preserved by call_soon FIFO.
+        # across rapid transitions is preserved by the pump's FIFO.
         try:
-            loop = asyncio.get_running_loop()
+            asyncio.get_running_loop()
         except RuntimeError:
-            loop = None
-        if loop is not None:
-            loop.call_soon(self.emit, 'stateChanged', state)
-        else:
             # No loop (e.g. pure-unit tests of sync FSMs): emit inline.
             self.emit('stateChanged', state)
+        else:
+            runq.defer(self.emit, 'stateChanged', state)
 
     if _native is None:
         _goto_state = _py_goto_state

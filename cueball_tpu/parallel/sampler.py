@@ -473,6 +473,19 @@ class FleetSampler:
         # In-place clear: the handles hold this very set object.
         patch.clear()
 
+    def gather_once(self) -> int:
+        """Run one incremental host gather outside a full tick: re-read
+        signals for exactly the rows whose pools marked themselves
+        dirty (plus the polled fallback rows) and fold them into the
+        live columns. Returns the number of rows visited.
+
+        This is the host-side cost a tick pays for gathering — O(dirty),
+        not O(fleet) — exposed on its own so callers (the bench's
+        gather curve, operators probing a quiet fleet) can weigh it
+        without also paying the device step and publish."""
+        self._patch_dirty_rows()
+        return self.fs_tick_visits
+
     def _place_inputs(self, arrays: dict, now: float):
         """Host tick columns -> device FleetInputs, re-shipping only
         the fields whose values changed since the previous tick.

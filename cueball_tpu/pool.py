@@ -38,6 +38,7 @@ from .connection_fsm import ConnectionSlotFSM, CueBallClaimHandle
 from .cqueue import Queue
 from .events import EventEmitter
 from .fsm import FSM, get_loop
+from .runq import defer
 
 # Low-pass filter parameters (reference lib/pool.js:43-48): 5 Hz sampling,
 # 128-tap EMA with time constant -0.2 -> pass band ~0.25 Hz, -10 dB at
@@ -45,17 +46,9 @@ from .fsm import FSM, get_loop
 LP_RATE = 5
 LP_INT = round(1000 / LP_RATE)
 
-# CoDel pacer cadence (ms). Classic CoDel evaluates its control law at
-# every dequeue of a busy queue; a connection pool dequeues only when a
-# connection is released, so with long checkout holds the drop decisions
-# quantize onto the release cadence (plus the 100 ms re-arm interval)
-# and the achieved claim sojourn sits well above targetClaimDelay. While
-# the service process is demonstrably live, the pacer runs a shave-mode
-# law between dequeues: CoDel's entry condition (head above target for a
-# full control interval), then shed every above-target waiter per tick,
-# with hysteretic exit. ControlledDelay itself is untouched and still
-# consulted at dequeue sites. See docs/internals.md (CoDel section).
-CODEL_PACE = 10
+# CoDel pacer cadence lives with the rest of the control-law constants
+# (re-exported here for back-compat; see codel.py for the rationale).
+CODEL_PACE = mod_codel.CODEL_PACE
 
 # Fleet-actuation advisory freshness bound (ms): ~5 sampler ticks at
 # the default 200 ms cadence. Older advisories are ignored and the
@@ -204,7 +197,7 @@ class ConnectionPool(FSM):
         tcd = options.get('targetClaimDelay')
         if isinstance(tcd, (int, float)) and math.isfinite(tcd):
             self.p_codel = mod_codel.ControlledDelay(tcd)
-        # Continuous-evaluation pacer state (see CODEL_PACE above): armed
+        # Continuous-evaluation pacer state (see codel.CODEL_PACE): armed
         # while a standing queue exists; drops only while a dequeue has
         # happened within the last control interval, so a fully stalled
         # pool keeps the reference's shed-at-dequeue/getMaxIdle-bound
@@ -739,7 +732,7 @@ class ConnectionPool(FSM):
         if self.p_rebal_scheduled is not False:
             return
         self.p_rebal_scheduled = True
-        get_loop().call_soon(self._rebalance)
+        defer(self._rebalance)
 
     def _rebalance(self) -> None:
         """Compute and apply a plan toward even distribution
@@ -1066,7 +1059,7 @@ class ConnectionPool(FSM):
                 if not state['done']:
                     cb(mod_errors.PoolStoppingError(self))
                 state['done'] = True
-            get_loop().call_soon(fail_stopping)
+            defer(fail_stopping)
             return _CancelShim(state)
         if self.is_in_state('failed'):
             def fail_failed():
@@ -1074,7 +1067,7 @@ class ConnectionPool(FSM):
                     cb(mod_errors.PoolFailedError(
                         self, self.p_last_error))
                 state['done'] = True
-            get_loop().call_soon(fail_failed)
+            defer(fail_failed)
             return _CancelShim(state)
 
         e = mod_utils.maybe_capture_stack_trace()
@@ -1131,7 +1124,7 @@ class ConnectionPool(FSM):
         # unlink on resolution lives in the handle's own state entries
         # (_ch_unpark) — no per-claim stateChanged subscription.
         handle.ch_requeue = try_next
-        get_loop().call_soon(try_next)
+        defer(try_next)
 
         return handle
 

@@ -230,8 +230,8 @@ class _StaticInner(EventEmitter):
             for be in self.sr_backends:
                 self.emit('added', srv_key(be), be)
             self.emit('updated')
-        from .fsm import get_loop
-        get_loop().call_soon(emit_all)
+        from .runq import defer
+        defer(emit_all)
 
     def stop(self) -> None:
         if self.sr_state != 'started':

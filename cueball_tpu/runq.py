@@ -1,0 +1,122 @@
+"""Single-pump engine run queue (setImmediate-phase analogue).
+
+Every deferral the FSM engine issues — gated ``S.immediate``
+callbacks, deferred ``stateChanged`` emissions, the claim path's
+``try_next``/requeue hops, the cset stopping drain — used to be its
+own ``loop.call_soon``, and each one paid a full asyncio ``Handle`` +
+contextvars ``Context.run`` round trip (~13% of a claim/release cycle,
+docs/claim-path-profile.md round 5). ``defer()`` instead pushes one
+entry onto a per-loop FIFO and schedules at most ONE pump callback per
+loop tick to drain it: N deferrals per tick cost one Handle/Context,
+the way node batches the whole ``setImmediate`` phase for the
+reference.
+
+Ordering contract (the iteration-boundary semantics of node's
+setImmediate phase):
+
+- entries drain in push order — engine deferrals stay FIFO among
+  themselves, and against plain user ``call_soon`` callbacks the burst
+  occupies the loop slot of its FIRST deferral (a user callback
+  scheduled before the burst runs before it, one scheduled after the
+  burst runs after it; a callback scheduled mid-burst observes the
+  batch as one unit, exactly node's setImmediate-phase behaviour);
+- the drain only delivers the entries present when it starts: pushes
+  made DURING a drain open a fresh batch drained by a new pump on the
+  NEXT loop iteration, never the same drain — same-tick execution
+  would collapse the reference's two-loop-tick claim cycle
+  (lib/pool.js:859-969 semantics);
+- a raising entry is routed to ``loop.call_exception_handler`` and the
+  rest of the batch still drains, matching how an exception in an
+  individual ``call_soon`` callback behaves.
+
+``set_pump_enabled(False)`` (or ``CUEBALL_NO_PUMP=1`` at import)
+drops back to the reference's literal scheduling — one ``call_soon``
+per deferral, including each deferred ``stateChanged`` emission —
+which is what the interleaved off/on/off bench A/B (bench.py
+``bench_pump_ab``) measures against. Engine-deferral ordering is
+identical in both modes (the conformance suite pins a byte-identical
+pool transition trace across them); only the scheduling cost
+changes.
+
+The native engine implements the same queue in C
+(native/emitter.c pump machinery) and pushes its deferred
+``stateChanged`` emissions into it, so both engines share one pump
+and one FIFO.
+"""
+
+import asyncio
+import os
+
+from .events import _native
+
+__all__ = ['defer', 'pump_enabled', 'set_pump_enabled']
+
+
+if _native is not None:
+    defer = _native.pump_defer
+    _set_pump_enabled = _native.pump_set_enabled
+    _pump_enabled = _native.pump_enabled
+
+    def set_pump_enabled(flag):
+        """Enable/disable pump coalescing; returns the previous
+        setting (for try/finally restoration in benches and tests)."""
+        return _set_pump_enabled(bool(flag))
+
+    def pump_enabled():
+        return _pump_enabled()
+else:
+    _pending = {}  # loop -> list of (cb, *args) entry tuples
+    _enabled = True
+
+    def _pump(loop):
+        entries = _pending.pop(loop, None)
+        if entries is None:
+            return
+        for entry in entries:
+            try:
+                entry[0](*entry[1:])
+            except (SystemExit, KeyboardInterrupt):
+                raise
+            except BaseException as exc:
+                loop.call_exception_handler({
+                    'message': 'cueball runq deferral',
+                    'exception': exc,
+                })
+
+    def defer(cb, *args):
+        """Schedule ``cb(*args)`` for the next loop iteration on the
+        shared engine pump (plain ``call_soon`` when the pump is
+        disabled). Requires a running event loop, like call_soon."""
+        loop = asyncio.get_running_loop()
+        if not _enabled:
+            loop.call_soon(cb, *args)
+            return
+        batch = _pending.get(loop)
+        if batch is not None:
+            # Pump already scheduled for this loop's current tick.
+            batch.append((cb,) + args)
+            return
+        if _pending:
+            # Batches stranded on loops that closed before draining
+            # died with their loop (like undelivered call_soon
+            # handles); prune so they can't accumulate across
+            # asyncio.run() calls.
+            for stale in [ln for ln in _pending if ln.is_closed()]:
+                del _pending[stale]
+        _pending[loop] = [(cb,) + args]
+        loop.call_soon(_pump, loop)
+
+    def set_pump_enabled(flag):
+        """Enable/disable pump coalescing; returns the previous
+        setting (for try/finally restoration in benches and tests)."""
+        global _enabled
+        old = _enabled
+        _enabled = bool(flag)
+        return old
+
+    def pump_enabled():
+        return _enabled
+
+
+if os.environ.get('CUEBALL_NO_PUMP'):
+    set_pump_enabled(False)

@@ -29,6 +29,41 @@
 #include <structmember.h>
 #include <time.h>
 
+/* Python < 3.12 compatibility: the single-object exception API this
+   file uses landed in 3.12. Express it via the legacy Fetch/Restore
+   triple on older runtimes — without this the extension compiles (the
+   calls are implicitly declared) but fails to load with an undefined
+   symbol, silently dropping the whole process to the pure-Python
+   engine. Same stealing/new-reference contracts as the originals. */
+#if PY_VERSION_HEX < 0x030c0000
+static PyObject *
+PyErr_GetRaisedException(void)
+{
+    PyObject *t, *v, *tb;
+    PyErr_Fetch(&t, &v, &tb);
+    if (t == NULL)
+        return NULL;
+    PyErr_NormalizeException(&t, &v, &tb);
+    if (tb != NULL && PyException_SetTraceback(v, tb) < 0)
+        PyErr_Clear();
+    Py_DECREF(t);
+    Py_XDECREF(tb);
+    return v;
+}
+
+static void
+PyErr_SetRaisedException(PyObject *exc)
+{
+    /* Steals the reference to exc, like the 3.12 original. */
+    if (exc == NULL) {
+        PyErr_Clear();
+        return;
+    }
+    PyErr_Restore(Py_NewRef((PyObject *)Py_TYPE(exc)), exc,
+                  PyException_GetTraceback(exc));
+}
+#endif
+
 /* ------------------------------------------------------------------ */
 /* Once wrapper                                                        */
 
@@ -667,7 +702,38 @@ typedef struct EmitterObject_ {
     PyObject_HEAD
     PyObject *ee_listeners;  /* dict: str -> list */
     PyObject *inst_dict;     /* instance __dict__ (tp_dictoffset) */
+    unsigned long long ee_mutations;  /* external-listener epoch */
 } EmitterObject;
+
+/* True for listeners the framework registers on its own behalf (state
+   gates, and anything carrying a truthy _cueball_internal attribute —
+   the same filter count_external applies). Their add/remove churn
+   never changes what count_external reports, so it must not advance
+   ee_mutations — otherwise every claim's own error gate would
+   invalidate the leak-check count cache it exists to serve
+   (connection_fsm.py state_claimed). The type checks short-circuit
+   the common engine-gate case before paying an attribute lookup. */
+static PyObject *getattr_or_null(PyObject *o, PyObject *name);
+
+static int
+emitter_listener_is_internal(PyObject *listener)
+{
+    if (Py_TYPE(listener) == &Gate_Type ||
+            Py_TYPE(listener) == &GotoGate_Type)
+        return 1;
+    PyObject *v = getattr_or_null(listener, str_cueball_internal);
+    if (v == NULL) {
+        /* The epoch bump is advisory; a raising property must not
+           poison this add/remove call with a stray exception. */
+        PyErr_Clear();
+        return 0;
+    }
+    int truthy = PyObject_IsTrue(v);
+    Py_DECREF(v);
+    if (truthy < 0)
+        PyErr_Clear();  /* raising __bool__: same advisory treatment */
+    return truthy > 0;
+}
 
 static int
 Emitter_traverse(EmitterObject *self, visitproc visit, void *arg)
@@ -720,6 +786,11 @@ Emitter_init(EmitterObject *self, PyObject *args, PyObject *kwargs)
 static int
 emitter_on_impl(EmitterObject *self, PyObject *event, PyObject *listener)
 {
+    /* Classify BEFORE touching the listener table: the attribute
+       lookup can run arbitrary user code (a _cueball_internal
+       property), which must observe the registration as not having
+       happened yet rather than re-enter mid-append. */
+    int external = !emitter_listener_is_internal(listener);
     PyObject *lst = PyDict_GetItemWithError(self->ee_listeners, event);
     if (lst == NULL) {
         if (PyErr_Occurred())
@@ -733,7 +804,11 @@ emitter_on_impl(EmitterObject *self, PyObject *event, PyObject *listener)
         }
         Py_DECREF(lst);  /* dict holds it */
     }
-    return PyList_Append(lst, listener);
+    if (PyList_Append(lst, listener) < 0)
+        return -1;
+    if (external)
+        self->ee_mutations++;
+    return 0;
 }
 
 static PyObject *
@@ -825,8 +900,20 @@ Emitter_remove_listener(EmitterObject *self, PyObject *args)
         }
     }
     if (hit >= 0) {
-        if (PyList_SetSlice(lst, hit, hit + 1, NULL) < 0)
+        /* Strong ref across the classification: a _cueball_internal
+           property can mutate the listener list and drop its ref to
+           the entry mid-lookup. */
+        PyObject *victim = PyList_GET_ITEM(lst, hit);
+        Py_INCREF(victim);
+        int external = !emitter_listener_is_internal(victim);
+        int still_there = hit < PyList_GET_SIZE(lst) &&
+            PyList_GET_ITEM(lst, hit) == victim;
+        Py_DECREF(victim);
+        if (still_there &&
+                PyList_SetSlice(lst, hit, hit + 1, NULL) < 0)
             return NULL;
+        if (external)
+            self->ee_mutations++;
         if (PyList_GET_SIZE(lst) == 0) {
             if (PyDict_DelItem(self->ee_listeners, event) < 0)
                 PyErr_Clear();
@@ -841,6 +928,9 @@ Emitter_remove_all_listeners(EmitterObject *self, PyObject *args)
     PyObject *event = Py_None;
     if (!PyArg_ParseTuple(args, "|O", &event))
         return NULL;
+    /* Conservative epoch bump (even when nothing was registered):
+       a spurious bump only costs one extra leak-check sweep. */
+    self->ee_mutations++;
     if (event == Py_None) {
         PyDict_Clear(self->ee_listeners);
     } else {
@@ -978,6 +1068,13 @@ Emitter_count_external(EmitterObject *self, PyObject *args)
     }
     Py_DECREF(lst);
     return PyLong_FromLong(count);
+}
+
+static PyObject *
+Emitter_mutation_count(EmitterObject *self, PyObject *noargs)
+{
+    (void)noargs;
+    return PyLong_FromUnsignedLongLong(self->ee_mutations);
 }
 
 static PyObject *
@@ -1157,6 +1254,11 @@ static PyMethodDef Emitter_methods[] = {
      "Number of listeners for event."},
     {"count_external", (PyCFunction)Emitter_count_external, METH_VARARGS,
      "Number of non-framework listeners for event."},
+    {"mutation_count", (PyCFunction)Emitter_mutation_count, METH_NOARGS,
+     "Monotonic count of externally-visible listener-table mutations "
+     "(framework gate churn excluded); equal counts mean every "
+     "count_external() answer is unchanged, which lets the claim leak "
+     "check skip its per-release sweep."},
     {"is_in_state", (PyCFunction)Emitter_is_in_state, METH_O,
      "FSM current-state test, sub-state aware (\"a.b\" is in \"a\"); "
      "fsm.py rebinds this onto FSM when the native core is active."},
@@ -1225,6 +1327,7 @@ static PyObject *str_fsm_pending;      /* "_fsm_pending" */
 static PyObject *str_is_closed;        /* "is_closed" */
 static PyObject *str_check_transition; /* "_check_transition" */
 static PyObject *str_run_transition;   /* "_run_transition" */
+static PyObject *str_pump_deferral;    /* "cueball runq deferral" */
 static PyObject *emitter_on_descr;     /* base EventEmitter.on descr */
 static PyObject *fsm_check_thin;       /* stock FSM._check_transition */
 static PyObject *fsm_run_thin;         /* stock FSM._run_transition */
@@ -1247,100 +1350,41 @@ emitter_internal_on_fast(PyObject *emitter)
         Py_True;
 }
 
-/* Coalesced deferred stateChanged emission.
-
-   The reference emits stateChanged via setImmediate (mooremachine);
-   the Python engine mirrors that with one loop.call_soon per
-   transition. On the claim hot path that is ~6 call_soon round-trips
-   through asyncio's Python scheduling machinery per claim/release
-   cycle. Instead, C batches the (fsm, state) pairs of a synchronous
-   burst and schedules ONE call_soon that drains the batch FIFO.
-
-   Iteration-boundary semantics are preserved exactly: the drain only
-   delivers the entries present when it starts; emissions queued
-   *during* the drain go to a fresh batch drained by a new call_soon on
-   the next loop iteration — which is also how node's setImmediate
-   treats immediates queued from an immediate. Per-emission exceptions
-   are routed to loop.call_exception_handler({'message', 'exception'})
-   and do not stop the rest of the batch, matching how an exception in
-   an individual call_soon callback behaves.
-
-   Batches are tracked PER LOOP (dict loop -> flat [fsm1, state1, ...]):
-   FSMs living on different event loops (multi-threaded asyncio, or a
-   second loop in-process) each get their own batch and their own
-   call_soon, so one loop scheduling can never drop another live loop's
-   still-pending emissions. An entry's presence in the dict means its
-   drain callback is scheduled. Batches stranded on loops that closed
-   before draining are pruned lazily at the next schedule. */
-static PyObject *drain_map;       /* dict: loop -> flat pending list */
-static PyObject *drain_callable;  /* the module-level drain fn */
-
-static PyObject *
-fsm_drain_state_changed(PyObject *mod, PyObject *loop)
+/* Route the pending exception to loop.call_exception_handler — what
+   asyncio does when an individual call_soon callback raises — so a
+   failing batch entry never stops the rest of its batch. Falls back
+   to PyErr_WriteUnraisable(blame). Always leaves the error indicator
+   clear. */
+static void
+sched_route_exception(PyObject *loop, PyObject *blame, PyObject *message)
 {
-    (void)mod;
-    if (drain_map == NULL)
-        Py_RETURN_NONE;
-    PyObject *batch = PyDict_GetItemWithError(drain_map, loop);
-    if (batch == NULL) {
-        if (PyErr_Occurred())
-            return NULL;
-        Py_RETURN_NONE;
-    }
-    /* Detach before delivering: emissions queued during the drain open
-       a fresh batch (and a fresh call_soon), preserving the
-       iteration-boundary semantics of setImmediate. */
-    Py_INCREF(batch);
-    if (PyDict_DelItem(drain_map, loop) < 0) {
-        Py_DECREF(batch);
-        return NULL;
-    }
-    Py_INCREF(loop);
-
-    Py_ssize_t n = PyList_GET_SIZE(batch);
-    for (Py_ssize_t i = 0; i + 1 < n; i += 2) {
-        PyObject *fsm = PyList_GET_ITEM(batch, i);
-        PyObject *state = PyList_GET_ITEM(batch, i + 1);
-        PyObject *r = PyObject_CallMethodObjArgs(
-            fsm, str_emit, str_state_changed, state, NULL);
-        if (r != NULL) {
-            Py_DECREF(r);
-            continue;
-        }
-        /* Route to the loop's exception handler (what asyncio does
-           for a failing call_soon callback) and keep draining. */
-        PyObject *exc = PyErr_GetRaisedException();
-        if (exc == NULL)
-            continue;
-        int handled = 0;
-        if (loop != NULL) {
-            PyObject *ctx = PyDict_New();
-            if (ctx != NULL &&
-                PyDict_SetItem(ctx, str_message,
-                               str_state_changed) == 0 &&
-                PyDict_SetItem(ctx, str_exception, exc) == 0) {
-                PyObject *hr = PyObject_CallMethodObjArgs(
-                    loop, str_call_exc_handler, ctx, NULL);
-                if (hr != NULL) {
-                    Py_DECREF(hr);
-                    handled = 1;
-                } else {
-                    PyErr_Clear();
-                }
+    PyObject *exc = PyErr_GetRaisedException();
+    if (exc == NULL)
+        return;
+    int handled = 0;
+    if (loop != NULL) {
+        PyObject *ctx = PyDict_New();
+        if (ctx != NULL &&
+            PyDict_SetItem(ctx, str_message, message) == 0 &&
+            PyDict_SetItem(ctx, str_exception, exc) == 0) {
+            PyObject *hr = PyObject_CallMethodObjArgs(
+                loop, str_call_exc_handler, ctx, NULL);
+            if (hr != NULL) {
+                Py_DECREF(hr);
+                handled = 1;
             } else {
                 PyErr_Clear();
             }
-            Py_XDECREF(ctx);
+        } else {
+            PyErr_Clear();
         }
-        if (!handled) {
-            PyErr_SetRaisedException(Py_NewRef(exc));
-            PyErr_WriteUnraisable(fsm);
-        }
-        Py_DECREF(exc);
+        Py_XDECREF(ctx);
     }
-    Py_DECREF(batch);
-    Py_XDECREF(loop);
-    Py_RETURN_NONE;
+    if (!handled) {
+        PyErr_SetRaisedException(Py_NewRef(exc));
+        PyErr_WriteUnraisable(blame);
+    }
+    Py_DECREF(exc);
 }
 
 /* Drop batches whose loop closed before its drain callback ran (their
@@ -1348,9 +1392,9 @@ fsm_drain_state_changed(PyObject *mod, PyObject *loop)
    handles on a closed loop); without this, entries accumulate across
    asyncio.run() calls. Best-effort: never raises. */
 static void
-drain_prune_closed(void)
+sched_prune_closed(PyObject *map)
 {
-    PyObject *keys = PyDict_Keys(drain_map);
+    PyObject *keys = PyDict_Keys(map);
     if (keys == NULL) {
         PyErr_Clear();
         return;
@@ -1365,7 +1409,7 @@ drain_prune_closed(void)
         int closed = PyObject_IsTrue(c);
         Py_DECREF(c);
         if (closed > 0) {
-            if (PyDict_DelItem(drain_map, k) < 0)
+            if (PyDict_DelItem(map, k) < 0)
                 PyErr_Clear();
         } else if (closed < 0) {
             PyErr_Clear();
@@ -1374,59 +1418,198 @@ drain_prune_closed(void)
     Py_DECREF(keys);
 }
 
-/* Queue one deferred stateChanged emission on `loop`. Returns 0/-1. */
-static int
-fsm_schedule_state_changed(PyObject *loop, PyObject *fsm, PyObject *state)
+/* ------------------------------------------------------------------ */
+/* Single-pump engine run queue.
+
+   The reference emits stateChanged via setImmediate (mooremachine) and
+   defers its claim-path hops the same way; the Python engine mirrors
+   each with one loop.call_soon, ~6 call_soon round-trips through
+   asyncio's Python scheduling machinery per claim/release cycle. Here
+   instead EVERY engine deferral — gated S.immediate callbacks,
+   the claim path's try_next/requeue hops, the cset stopping drain, and
+   the deferred stateChanged emissions themselves — pushes ONE entry
+   onto a per-loop FIFO, and at most one pump callback per loop tick
+   drains it. N deferrals per tick cost one asyncio Handle + contextvars
+   Context instead of N — the way node batches the whole setImmediate
+   phase for the reference. (This generalizes the earlier drain_map,
+   which coalesced only stateChanged bursts: one queue for every
+   deferral kind keeps them globally FIFO against each other, matching
+   node's per-setImmediate ordering, where the two-mechanism split let
+   stateChanged bursts jump ahead of interleaved generic deferrals.)
+
+   Entry encoding (one tuple per entry, kept in arrival order so engine
+   deferrals stay globally FIFO across kinds):
+
+     (None, fsm, state)   deferred stateChanged emission
+     (callable, *args)    generic deferral (pump_defer; the VARARGS
+                          args tuple itself is the entry — pushing
+                          costs zero extra allocations)
+
+   Batches are tracked PER LOOP (FSMs on different event loops each
+   get their own batch and pump callback); batches stranded on loops
+   that closed before draining are pruned lazily at the next push.
+
+   Iteration-boundary semantics: the drain detaches its batch first,
+   so entries pushed DURING a drain go to a fresh batch drained by a
+   new call_soon on the NEXT loop iteration (same-tick execution would
+   collapse the reference's two-loop-tick claim cycle,
+   lib/pool.js:859-969) — also how node's setImmediate treats
+   immediates queued from an immediate. Per-entry exceptions route
+   through sched_route_exception and the batch keeps draining.
+
+   pump_on gates coalescing (bench off/on/off A/B arms,
+   CUEBALL_NO_PUMP): disabled, every deferral — including each
+   stateChanged emission — degrades to its own plain loop.call_soon,
+   the reference's literal one-setImmediate-per-deferral scheduling.
+   Ordering is preserved bit-for-bit either way (the conformance
+   suite pins a byte-identical pool transition trace across modes);
+   only the scheduling cost changes. */
+static PyObject *pump_map;       /* dict: loop -> list of entry tuples */
+static PyObject *pump_callable;  /* the module-level pump_drain fn */
+static int pump_on = 1;
+
+static PyObject *
+pump_drain(PyObject *mod, PyObject *loop)
 {
-    /* drain_map is allocated once in PyInit: lazy creation here could
-       race two threads' first transitions (a GC pass inside PyDict_New
-       can switch the GIL), one thread's fresh dict overwriting the
-       other's already-scheduled batch. */
-    PyObject *batch = PyDict_GetItemWithError(drain_map, loop);
-    if (batch != NULL) {
-        /* Existing batch: its drain is already scheduled. */
-        if (PyList_Append(batch, fsm) < 0)
-            return -1;
-        if (PyList_Append(batch, state) < 0) {
-            /* Keep the installed batch even-length: a dangling fsm
-               would misalign every later (fsm, state) pair the drain
-               delivers. */
-            Py_ssize_t bn = PyList_GET_SIZE(batch);
-            PyObject *exc = PyErr_GetRaisedException();
-            if (PyList_SetSlice(batch, bn - 1, bn, NULL) < 0)
-                PyErr_Clear();
-            PyErr_SetRaisedException(exc);
-            return -1;
-        }
-        return 0;
+    (void)mod;
+    if (pump_map == NULL)
+        Py_RETURN_NONE;
+    PyObject *batch = PyDict_GetItemWithError(pump_map, loop);
+    if (batch == NULL) {
+        if (PyErr_Occurred())
+            return NULL;
+        Py_RETURN_NONE;
     }
+    /* Detach before delivering (see block comment above). */
+    Py_INCREF(batch);
+    if (PyDict_DelItem(pump_map, loop) < 0) {
+        Py_DECREF(batch);
+        return NULL;
+    }
+    Py_INCREF(loop);
+
+    Py_ssize_t n = PyList_GET_SIZE(batch);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *entry = PyList_GET_ITEM(batch, i);
+        PyObject *first = PyTuple_GET_ITEM(entry, 0);
+        PyObject *r, *blame, *msg;
+        if (first == Py_None) {
+            blame = PyTuple_GET_ITEM(entry, 1);
+            msg = str_state_changed;
+            r = PyObject_CallMethodObjArgs(
+                blame, str_emit, str_state_changed,
+                PyTuple_GET_ITEM(entry, 2), NULL);
+        } else {
+            blame = first;
+            msg = str_pump_deferral;
+            r = PyObject_Vectorcall(
+                first, ((PyTupleObject *)entry)->ob_item + 1,
+                (size_t)(PyTuple_GET_SIZE(entry) - 1), NULL);
+        }
+        if (r != NULL) {
+            Py_DECREF(r);
+            continue;
+        }
+        sched_route_exception(loop, blame, msg);
+    }
+    Py_DECREF(batch);
+    Py_DECREF(loop);
+    Py_RETURN_NONE;
+}
+
+/* Append one entry to `loop`'s pending pump batch, scheduling the
+   pump callback when the batch is fresh. Borrows entry; returns 0/-1.
+   Same structure (and the same lazy-creation prohibition) as
+   fsm_schedule_state_changed above. */
+static int
+pump_push(PyObject *loop, PyObject *entry)
+{
+    PyObject *batch = PyDict_GetItemWithError(pump_map, loop);
+    if (batch != NULL)
+        return PyList_Append(batch, entry);
     if (PyErr_Occurred())
         return -1;
-    if (PyDict_GET_SIZE(drain_map) > 0)
-        drain_prune_closed();
+    if (PyDict_GET_SIZE(pump_map) > 0)
+        sched_prune_closed(pump_map);
     batch = PyList_New(0);
     if (batch == NULL)
         return -1;
-    if (PyList_Append(batch, fsm) < 0 ||
-        PyList_Append(batch, state) < 0 ||
-        PyDict_SetItem(drain_map, loop, batch) < 0) {
+    if (PyList_Append(batch, entry) < 0 ||
+        PyDict_SetItem(pump_map, loop, batch) < 0) {
         Py_DECREF(batch);
         return -1;
     }
     Py_DECREF(batch);  /* dict holds it */
     PyObject *r = PyObject_CallMethodObjArgs(
-        loop, str_call_soon, drain_callable, loop, NULL);
+        loop, str_call_soon, pump_callable, loop, NULL);
     if (r == NULL) {
-        /* No drain will run; drop the dead entry so a later schedule
-           on this loop starts clean (preserving call_soon's error). */
+        /* No pump will run; drop the dead entry so a later push on
+           this loop starts clean (preserving call_soon's error). */
         PyObject *exc = PyErr_GetRaisedException();
-        if (PyDict_DelItem(drain_map, loop) < 0)
+        if (PyDict_DelItem(pump_map, loop) < 0)
             PyErr_Clear();
         PyErr_SetRaisedException(exc);
         return -1;
     }
     Py_DECREF(r);
     return 0;
+}
+
+static PyObject *
+pump_defer(PyObject *mod, PyObject *args)
+{
+    (void)mod;
+    if (PyTuple_GET_SIZE(args) < 1) {
+        PyErr_SetString(PyExc_TypeError,
+                        "pump_defer() requires a callable argument");
+        return NULL;
+    }
+    if (fsm_get_running_loop == NULL) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "pump_defer() before fsm_configure()");
+        return NULL;
+    }
+    PyObject *loop = PyObject_CallNoArgs(fsm_get_running_loop);
+    if (loop == NULL)
+        return NULL;
+    if (!pump_on) {
+        /* args is exactly (cb, *cb_args) — call_soon's signature. */
+        PyObject *cs = PyObject_GetAttr(loop, str_call_soon);
+        Py_DECREF(loop);
+        if (cs == NULL)
+            return NULL;
+        PyObject *r = PyObject_Call(cs, args, NULL);
+        Py_DECREF(cs);
+        if (r == NULL)
+            return NULL;
+        Py_DECREF(r);
+        Py_RETURN_NONE;
+    }
+    int rc = pump_push(loop, args);
+    Py_DECREF(loop);
+    if (rc < 0)
+        return NULL;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+pump_set_enabled(PyObject *mod, PyObject *flag)
+{
+    (void)mod;
+    int f = PyObject_IsTrue(flag);
+    if (f < 0)
+        return NULL;
+    int old = pump_on;
+    pump_on = f;
+    return PyBool_FromLong(old);
+}
+
+static PyObject *
+pump_enabled(PyObject *mod, PyObject *noargs)
+{
+    (void)mod;
+    (void)noargs;
+    return PyBool_FromLong(pump_on);
 }
 
 static PyObject *
@@ -1747,7 +1930,27 @@ fsm_run_transition_impl(PyObject *fsm, PyObject *state)
                 goto fail;
             Py_DECREF(r);
         } else {
-            int rc = fsm_schedule_state_changed(loop, fsm, state);
+            int rc;
+            if (pump_on) {
+                PyObject *pe = PyTuple_Pack(3, Py_None, fsm, state);
+                rc = (pe == NULL) ? -1 : pump_push(loop, pe);
+                Py_XDECREF(pe);
+            } else {
+                /* Pump disabled: the reference's literal scheduling,
+                   one call_soon per deferred emission. */
+                rc = -1;
+                PyObject *em = PyObject_GetAttr(fsm, str_emit);
+                if (em != NULL) {
+                    PyObject *r = PyObject_CallMethodObjArgs(
+                        loop, str_call_soon, em, str_state_changed,
+                        state, NULL);
+                    Py_DECREF(em);
+                    if (r != NULL) {
+                        Py_DECREF(r);
+                        rc = 0;
+                    }
+                }
+            }
             Py_DECREF(loop);
             if (rc < 0)
                 goto fail;
@@ -1958,12 +2161,18 @@ static PyMethodDef native_methods[] = {
      "the stock functions let the engine detect subclass overrides."},
     {"fsm_run_transition", (PyCFunction)fsm_run_transition, METH_VARARGS,
      "Run one FSM state transition (C port of FSM._run_transition)."},
-    {"fsm_drain_state_changed", (PyCFunction)fsm_drain_state_changed,
-     METH_O,
-     "Deliver the pending batch of deferred stateChanged emissions "
-     "for the given event loop."},
     {"fsm_goto_state", (PyCFunction)fsm_goto_state, METH_VARARGS,
      "Request an FSM transition (C port of FSM._goto_state)."},
+    {"pump_drain", (PyCFunction)pump_drain, METH_O,
+     "Deliver the pending run-queue batch for the given event loop "
+     "(one pump callback drains every engine deferral of the tick)."},
+    {"pump_defer", (PyCFunction)pump_defer, METH_VARARGS,
+     "pump_defer(cb, *args): run cb(*args) next loop iteration on the "
+     "shared engine pump (plain call_soon when the pump is disabled)."},
+    {"pump_set_enabled", (PyCFunction)pump_set_enabled, METH_O,
+     "Enable/disable pump coalescing; returns the previous setting."},
+    {"pump_enabled", (PyCFunction)pump_enabled, METH_NOARGS,
+     "Whether pump coalescing is currently enabled."},
     {NULL}
 };
 
@@ -2035,7 +2244,9 @@ PyInit__cueball_native(void)
         (str_check_transition =
             PyUnicode_InternFromString("_check_transition")) == NULL ||
         (str_run_transition =
-            PyUnicode_InternFromString("_run_transition")) == NULL)
+            PyUnicode_InternFromString("_run_transition")) == NULL ||
+        (str_pump_deferral =
+            PyUnicode_InternFromString("cueball runq deferral")) == NULL)
         return NULL;
 
     if (PyType_Ready(&Emitter_Type) < 0 ||
@@ -2057,10 +2268,12 @@ PyInit__cueball_native(void)
     }
     Py_INCREF(emitter_on_descr);
 
-    /* Allocated once here, never lazily (see
-       fsm_schedule_state_changed). */
-    drain_map = PyDict_New();
-    if (drain_map == NULL)
+    /* Allocated once here, never lazily: lazy creation could race two
+       threads' first deferrals (a GC pass inside PyDict_New can switch
+       the GIL), one thread's fresh dict overwriting the other's
+       already-scheduled batch. */
+    pump_map = PyDict_New();
+    if (pump_map == NULL)
         return NULL;
 
     /* GotoGates are framework-internal listeners: make the marker
@@ -2075,9 +2288,9 @@ PyInit__cueball_native(void)
     if (m == NULL)
         return NULL;
 
-    /* The drain callback handed to loop.call_soon. */
-    drain_callable = PyObject_GetAttrString(m, "fsm_drain_state_changed");
-    if (drain_callable == NULL) {
+    /* The pump callback handed to loop.call_soon. */
+    pump_callable = PyObject_GetAttrString(m, "pump_drain");
+    if (pump_callable == NULL) {
         Py_DECREF(m);
         return NULL;
     }

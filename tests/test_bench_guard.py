@@ -119,6 +119,7 @@ def test_host_stages_land_without_chip():
     result = bench.assemble_result(1.0, claim, queued, host_tick, telem)
     assert result['sampler_tick_host_us']['64'] > 0
     assert result['sampler_gather_host_us']['64'] > 0
+    assert result['sampler_gather_full_host_us']['64'] > 0
     assert result['telemetry_error'] == 'chip tunnel down'
     # No live chip number -> the citation path runs; with only the
     # archived pre-guard artifact in-tree it must add nothing (no
@@ -145,6 +146,11 @@ def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
                 'off_post_ops_per_sec': 100.0,
                 'tracing_on_overhead_pct': 1.0}
 
+    async def fake_pump_ab():
+        return {'off_pre_ops_per_sec': 100.0, 'on_ops_per_sec': 112.0,
+                'off_post_ops_per_sec': 101.0,
+                'pump_on_gain_pct': 11.4}
+
     def boom(*a, **kw):
         raise AssertionError('chip stage must not run under host_only')
 
@@ -153,8 +159,10 @@ def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
     monkeypatch.setattr(bench, 'bench_queued_claim_throughput',
                         fake_queued)
     monkeypatch.setattr(bench, 'bench_tracing_ab', fake_tracing_ab)
+    monkeypatch.setattr(bench, 'bench_pump_ab', fake_pump_ab)
     monkeypatch.setattr(bench, 'bench_sampler_tick_host',
-                        lambda: {'tick_us_64': 10.0, 'gather_us_64': 5.0})
+                        lambda: {'tick_us_64': 10.0, 'gather_us_64': 5.0,
+                                 'gather_full_us_64': 40.0})
     monkeypatch.setattr(bench, 'bench_telemetry_step_guarded', boom)
     # Don't pin the pytest process to one core for the rest of the run.
     monkeypatch.setattr(bench.os, 'sched_setaffinity',
@@ -167,7 +175,10 @@ def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
     assert result['value'] == 2.5
     assert result['claim_release_ops_per_sec'] == 100.0
     assert result['sampler_tick_host_us'] == {'64': 10.0}
+    assert result['sampler_gather_host_us'] == {'64': 5.0}
+    assert result['sampler_gather_full_host_us'] == {'64': 40.0}
     assert result['claim_tracing_ab']['tracing_on_overhead_pct'] == 1.0
+    assert result['claim_pump_ab']['pump_on_gain_pct'] == 11.4
     assert result['telemetry_pools_per_sec'] is None
     assert 'telemetry_error' not in result
 
@@ -200,3 +211,36 @@ def test_tracing_off_overhead_within_noise():
     # protocol string documents the interleaving for the JSON reader.
     assert ab['on_ops_per_sec'] > 0
     assert 'interleaved' in ab['protocol']
+
+
+def test_pump_off_arms_within_noise():
+    """The same A/B-neutrality contract for the run-queue pump: 'off'
+    is the reference's literal one-call_soon-per-deferral scheduling,
+    so the two disabled arms (one before the pumped arm, one after)
+    must agree to within the noise floor. A drift here means
+    set_pump_enabled leaked state across arms — a batch stranded in
+    the FIFO, or the pump left on — and the recorded gain would be
+    measuring that leak, not the coalescing."""
+    import asyncio
+
+    from cueball_tpu import runq
+
+    was_on = runq.pump_enabled()
+    ab = asyncio.run(bench.bench_pump_ab(ops=1500, trials=3))
+    # The bench restores whatever mode the process was in.
+    assert runq.pump_enabled() == was_on
+    off_pre = ab['off_pre_ops_per_sec']
+    off_post = ab['off_post_ops_per_sec']
+    assert off_pre > 0 and off_post > 0
+    # Same envelope as the tracing guard: 3 sigma of the two disabled
+    # arms, floored at 25% of the pre rate so a shared CI host cannot
+    # flake the gate (the leak this guards costs far more than 25%).
+    envelope = max(3.0 * (ab['off_pre_stdev'] + ab['off_post_stdev']),
+                   0.25 * off_pre)
+    assert abs(off_post - off_pre) <= envelope, ab
+    # The pumped arm ran and the protocol records the interleaving.
+    assert ab['on_ops_per_sec'] > 0
+    assert 'interleaved' in ab['protocol']
+    # Scheduler diags ride along per arm (empty dicts only where the
+    # resource module is missing).
+    assert len(ab['on_trial_diags']) == len(ab['on_trials'])

@@ -365,6 +365,95 @@ def test_count_listeners_ignores_internal():
     run_async(t())
 
 
+def test_leak_check_still_warns_through_epoch_cache(caplog):
+    """The listener-epoch cache must never eat the leak warning: a
+    claimer that adds a listener and releases without removing it has
+    necessarily bumped the mutation epoch, so the release sweep runs
+    and trips (reference lib/connection-fsm.js:786-808)."""
+    import logging
+
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        conn = DummyConnection.instances[0]
+        conn.connect()
+        await settle()
+
+        hdl = make_handle(pool, lambda *a: None)
+        hdl.try_(slot)
+        await settle()
+        assert hdl.is_in_state('claimed')
+        conn.on('error', lambda e=None: None)  # leaked: never removed
+        hdl.release()
+        await settle()
+
+    with caplog.at_level(logging.WARNING, logger='cueball.claimhandle'):
+        run_async(t())
+    assert any('leaked event handlers' in r.getMessage()
+               for r in caplog.records)
+
+
+def test_unchanged_claims_skip_listener_count_sweep(monkeypatch):
+    """Claim/release cycles with zero external listener churn must not
+    re-walk the listener lists: the first claim pays the four-event
+    pre-count once, then the release check and every later claim reuse
+    the epoch-tagged snapshot (the ~7% count_external share of the
+    claim hot path, docs/claim-path-profile.md)."""
+    import cueball_tpu.connection_fsm as mod_cfsm
+    calls = []
+    real = count_listeners
+
+    def counting(emitter, event):
+        calls.append(event)
+        return real(emitter, event)
+
+    monkeypatch.setattr(mod_cfsm, 'count_listeners', counting)
+
+    async def t():
+        pool = FakePool()
+        slot = make_slot(pool)
+        slot.start()
+        await settle()
+        conn = DummyConnection.instances[0]
+        conn.connect()
+        await settle()
+
+        def cycle():
+            hdl = make_handle(pool, lambda *a: None)
+            hdl.try_(slot)
+            return hdl
+
+        hdl = cycle()
+        await settle()
+        first_claim = len(calls)   # the one paid pre-count walk
+        hdl.release()
+        await settle()
+        # Unchanged epoch: the release leak sweep was skipped entirely.
+        assert len(calls) == first_claim
+
+        hdl = cycle()
+        await settle()
+        hdl.release()
+        await settle()
+        # Second cycle reused the cached snapshot: zero extra walks.
+        assert len(calls) == first_claim
+
+        # A claimer that DOES touch listeners re-arms the machinery:
+        # balanced add/remove bumps the epoch, so the sweep runs (and
+        # finds nothing to warn about).
+        hdl = cycle()
+        await settle()
+        lsn = conn.on('error', lambda e=None: None)
+        conn.remove_listener('error', lsn)
+        hdl.release()
+        await settle()
+        assert len(calls) > first_claim
+
+    run_async(t())
+
+
 def test_ping_checker_runs_on_idle_timeout():
     async def t():
         pool = FakePool()

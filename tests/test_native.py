@@ -171,6 +171,58 @@ def test_once_dispatches_through_overridden_on(cls):
     assert seen == ['evt']
 
 
+@pytest.mark.parametrize('cls', CORES)
+def test_mutation_count_tracks_external_listeners_only(cls):
+    """Both cores expose the external-listener mutation epoch the leak
+    detector keys its skip on: user add/remove bumps it, framework
+    (gate / _cueball_internal) churn does not, and remove_all_listeners
+    bumps conservatively."""
+    e = cls()
+    base = e.mutation_count()
+
+    def internal():
+        pass
+    internal._cueball_internal = True
+    e.on('x', internal)
+    e.remove_listener('x', internal)
+    assert e.mutation_count() == base
+
+    user = e.on('x', lambda: None)
+    assert e.mutation_count() == base + 1
+    e.remove_listener('x', user)
+    assert e.mutation_count() == base + 2
+    # removing a listener that isn't registered moves nothing
+    e.remove_listener('x', user)
+    assert e.mutation_count() == base + 2
+    e.remove_all_listeners('x')
+    assert e.mutation_count() > base + 2
+
+
+def test_native_gate_registration_keeps_mutation_count():
+    """The FSM's own state-handle gates ride add/remove on every
+    transition; if they bumped the epoch, the leak detector's skip
+    would never engage on a live slot."""
+    from cueball_tpu.fsm import FSM
+
+    conn = native.EventEmitter()
+    base = conn.mutation_count()
+
+    class M(FSM):
+        def __init__(self):
+            super().__init__('a')
+
+        def state_a(self, S):
+            S.validTransitions(['b'])
+            S.on(conn, 'error', lambda *a: None)
+
+        def state_b(self, S):
+            S.validTransitions(['a'])
+
+    m = M()
+    m._goto_state('b')  # state exit removes the gate
+    assert conn.mutation_count() == base
+
+
 def test_gates_are_invisible_to_count_listeners():
     """Listeners the FSM registers through a StateHandle are framework-
     internal: they must not defeat the claimed-connection unhandled-

@@ -1,0 +1,298 @@
+"""Deferral-ordering conformance for the single-pump run queue.
+
+The pump (cueball_tpu/runq.py, native/emitter.c pump machinery)
+coalesces every engine deferral in a loop tick into ONE scheduled
+callback. That is a scheduling-cost change only — the reference's
+observable ordering contract (mooremachine defers via setImmediate;
+one deferred tick between claim_cb and the serve, deferred
+stateChanged delivery after release, lib/pool.js:859-969) must hold
+bit-for-bit. These tests pin the achievable contract:
+
+- engine deferrals drain in FIFO push order;
+- a user ``call_soon`` scheduled before a deferral burst runs before
+  the whole burst, one scheduled after it runs after it, and one
+  scheduled mid-burst observes the batch as a unit occupying the slot
+  of its first deferral — node's setImmediate-phase semantics, and
+  what the native drain_map already shipped for stateChanged bursts;
+- re-entrant pushes made during a drain land on the NEXT loop tick,
+  never the same drain;
+- a raising entry goes to loop.call_exception_handler and the rest of
+  the batch still drains;
+- the pool soak's runtime transition trace is identical pump-on vs
+  pump-off (the A/B arms measure cost, not behaviour).
+
+Both engines run this file: the native pump in C when
+_cueball_native is importable, the pure-Python pump under
+CUEBALL_NO_NATIVE=1 (make ci runs both cores).
+"""
+
+import asyncio
+import random
+
+import pytest
+
+import cueball_tpu.fsm as mod_fsm
+from cueball_tpu import runq
+
+from conftest import run_async, settle
+from soak_common import TopoChaos
+from test_pool import Ctx, make_pool
+
+
+@pytest.fixture(autouse=True)
+def _pump_on():
+    """Every test in this file starts from the default pump-on state
+    and restores whatever it toggled."""
+    prev = runq.set_pump_enabled(True)
+    yield
+    runq.set_pump_enabled(prev)
+
+
+def test_user_callbacks_around_a_burst_keep_their_positions():
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        order = []
+        loop.call_soon(order.append, 'user-before')
+        runq.defer(order.append, 'defer-a')
+        runq.defer(order.append, 'defer-b')
+        loop.call_soon(order.append, 'user-after')
+        await asyncio.sleep(0)
+        return order
+
+    assert run_async(scenario()) == \
+        ['user-before', 'defer-a', 'defer-b', 'user-after']
+
+
+def test_mid_burst_user_callback_sees_the_batch_as_one_unit():
+    # The burst occupies the loop slot of its FIRST deferral, so a
+    # user callback scheduled between two deferrals runs after the
+    # whole batch — node setImmediate-phase semantics, identical to
+    # what the native drain_map did for stateChanged bursts.
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        order = []
+        runq.defer(order.append, 'defer-a')
+        loop.call_soon(order.append, 'user-mid')
+        runq.defer(order.append, 'defer-b')
+        await asyncio.sleep(0)
+        return order
+
+    assert run_async(scenario()) == ['defer-a', 'defer-b', 'user-mid']
+
+
+def test_reentrant_defer_lands_next_tick_not_same_drain():
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        order = []
+
+        def x():
+            order.append('x')
+            runq.defer(order.append, 'y')
+            # Marks the tick boundary: scheduled after the re-entrant
+            # defer, so 'y' draining before it proves the fresh batch
+            # ran at the next iteration's pump slot, and 'z' sitting
+            # before 'y' proves it did NOT run inside the first drain.
+            loop.call_soon(order.append, 'tick-boundary')
+
+        runq.defer(x)
+        runq.defer(order.append, 'z')
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        return order
+
+    assert run_async(scenario()) == ['x', 'z', 'y', 'tick-boundary']
+
+
+def test_raising_entry_routes_to_exception_handler_and_drains_rest():
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        seen = {'order': [], 'errors': []}
+        loop.set_exception_handler(
+            lambda lp, ctx: seen['errors'].append(ctx))
+
+        def boom():
+            raise RuntimeError('pump entry failure')
+
+        runq.defer(seen['order'].append, 'a')
+        runq.defer(boom)
+        runq.defer(seen['order'].append, 'b')
+        await asyncio.sleep(0)
+        return seen
+
+    seen = run_async(scenario())
+    assert seen['order'] == ['a', 'b']
+    assert len(seen['errors']) == 1
+    assert isinstance(seen['errors'][0]['exception'], RuntimeError)
+
+
+def test_deferred_state_changed_interleaves_fifo_with_defers():
+    """A transition's deferred stateChanged emission is itself a pump
+    entry: it must drain in FIFO position relative to other engine
+    deferrals issued around it."""
+
+    class Toggle(mod_fsm.FSM):
+        def __init__(self):
+            super().__init__('a')
+
+        def state_a(self, S):
+            S.validTransitions(['b'])
+
+        def state_b(self, S):
+            S.validTransitions(['a'])
+
+    async def scenario():
+        order = []
+        f = Toggle()
+        await asyncio.sleep(0)  # flush the init transition's emit
+        f.on('stateChanged', lambda st: order.append(('sc', st)))
+        runq.defer(order.append, ('defer', 'pre'))
+        f._goto_state('b')      # deferred stateChanged -> pump entry
+        runq.defer(order.append, ('defer', 'post'))
+        await asyncio.sleep(0)
+        return order
+
+    assert run_async(scenario()) == \
+        [('defer', 'pre'), ('sc', 'b'), ('defer', 'post')]
+
+
+def test_pump_disabled_still_runs_deferrals():
+    async def scenario():
+        order = []
+        prev = runq.set_pump_enabled(False)
+        try:
+            runq.defer(order.append, 'a')
+            runq.defer(order.append, 'b')
+            await asyncio.sleep(0)
+        finally:
+            runq.set_pump_enabled(prev)
+        return order
+
+    assert run_async(scenario()) == ['a', 'b']
+
+
+async def _deterministic_soak(seed, actions=200):
+    """Seeded pool chaos like test_soak._soak, but with every wall
+    clock removed so the transition trace is reproducible: connect and
+    claim timeouts are armed far beyond the test's lifetime (never
+    fire), the retry backoff is zero (ripe immediately, so it fires at
+    a tick boundary rather than a wall-clock instant), and all
+    settling is sleep(0) tick counts. Every transition then flows
+    through call_soon/pump FIFO order only."""
+    rng = random.Random(seed)
+    # The pool draws from the GLOBAL random module too: resolver-added
+    # backends insert at random.randrange positions in the preference
+    # list (pool.on_resolver_added) and the backoff spread consumes a
+    # draw per retry (utils.gen_delay). Pin the global stream per run
+    # (restored by _traced_soak) or the preference order — and with it
+    # every rebalance plan — differs run to run.
+    random.seed(seed)
+    ctx = Ctx()
+    pool, inner = make_pool(ctx, spares=2, maximum=4, retries=2,
+                            timeout=600000, delay=0)
+    # The low-pass load sampler fires every 200 ms of WALL time — how
+    # many ticks land inside the run varies run to run, so it must not
+    # contribute transitions to a reproducibility-sensitive trace.
+    pool.p_lp_timer.cancel()
+    pool.p_rebal_timer_inst.cancel()
+    pool.p_shuffle_timer_inst.cancel()
+    chaos = TopoChaos(rng, ctx, inner)
+    held = []
+    waiters = []
+
+    def make_claim():
+        holder = {}
+
+        def cb(err, hdl=None, conn=None):
+            if holder.get('h') in waiters:
+                waiters.remove(holder['h'])
+            if err is None:
+                hdl._soak_conn = conn
+                hdl._soak_listener = conn.on('error', lambda e=None: None)
+                held.append(hdl)
+        holder['h'] = pool.claim_cb({'timeout': 600000}, cb)
+        waiters.append(holder['h'])
+
+    chaos.add_backend()
+    await settle()
+
+    for step in range(actions):
+        roll = rng.random()
+        if roll < 0.30:
+            chaos.connect_random()
+        elif roll < 0.40:
+            chaos.error_random(step)
+        elif roll < 0.45:
+            chaos.close_random()
+        elif roll < 0.55:
+            chaos.add_backend()
+        elif roll < 0.62:
+            chaos.remove_backend()
+        elif roll < 0.85:
+            make_claim()
+        elif roll < 0.93 and held:
+            h = held.pop(rng.randrange(len(held)))
+            h._soak_conn.remove_listener('error', h._soak_listener)
+            if rng.random() < 0.5:
+                h.release()
+            else:
+                h.close()
+        elif waiters:
+            w = waiters.pop(rng.randrange(len(waiters)))
+            w.cancel()
+        if step % 10 == 0:
+            await settle()
+
+    # Quiesce without wall clocks: return every lease, cancel every
+    # parked waiter, keep connecting stragglers, all on counted ticks.
+    for _ in range(200):
+        if not waiters and not held:
+            break
+        chaos.connect_stragglers()
+        while held:
+            h = held.pop()
+            h._soak_conn.remove_listener('error', h._soak_listener)
+            h.release()
+        for w in list(waiters):
+            waiters.remove(w)
+            w.cancel()
+        await settle()
+    pool.stop()
+    for _ in range(300):
+        if pool.is_in_state('stopped'):
+            break
+        # Slots mid-handshake hold the stop until their in-flight dummy
+        # connection resolves; keep driving those to completion.
+        chaos.connect_stragglers()
+        await settle()
+    assert pool.is_in_state('stopped')
+
+
+def _traced_soak(seed):
+    events = []
+
+    def tracer(fsm_obj, old, new):
+        events.append((type(fsm_obj).__name__, old, new))
+
+    mod_fsm.add_transition_tracer(tracer)
+    global_rng_state = random.getstate()
+    try:
+        run_async(_deterministic_soak(seed), timeout=90)
+    finally:
+        mod_fsm.remove_transition_tracer(tracer)
+        random.setstate(global_rng_state)
+    return events
+
+
+@pytest.mark.parametrize('seed', [7, 1234])
+def test_soak_transition_trace_identical_pump_on_vs_off(seed):
+    """The pump changes scheduling COST, not behaviour: the seeded
+    pool chaos must walk byte-identical transition sequences with the
+    pump on and off."""
+    on = _traced_soak(seed)
+    assert len(on) > 100   # the driver actually exercised the machines
+    prev = runq.set_pump_enabled(False)
+    try:
+        off = _traced_soak(seed)
+    finally:
+        runq.set_pump_enabled(prev)
+    assert on == off

@@ -620,6 +620,18 @@ class M:
         S.validTransitions([])
         loop.call_soon(self.poke)
 '''),
+    # F006: the same raw scheduling imported as a bare name — the
+    # attribute check alone would miss `from asyncio import
+    # ensure_future`.
+    ('F006', '''\
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        S.validTransitions([])
+        ensure_future(self.poke())
+'''),
     # F007: async state entry (and an await inside it).
     ('F007', '''\
 class M:
@@ -678,6 +690,26 @@ class M:
 
 def test_fsm_clean_machine_zero_false_positives(tmp_path):
     assert _fsm_codes(tmp_path, CLEAN_FSM) == set()
+
+
+def test_fsm_pump_defer_is_sanctioned(tmp_path):
+    """``defer`` (cueball_tpu.runq) is the engine's single-pump
+    deferral path: a state body using it — bare or via the module —
+    must NOT draw F006, while the raw names it replaces still do."""
+    src = '''\
+from cueball_tpu.runq import defer
+
+
+class M:
+    def __init__(self):
+        super().__init__('a')
+
+    def state_a(self, S):
+        S.validTransitions([])
+        defer(self.poke)
+        runq.defer(self.poke, 1)
+'''
+    assert _fsm_codes(tmp_path, src) == set()
 
 
 def test_fsm_edge_extraction_details(tmp_path):
