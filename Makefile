@@ -5,13 +5,27 @@ LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
 .PHONY: test check bench bench-host dryrun coverage native ci docs \
-	docs-check fsm-graph
+	docs-check fsm-graph scenarios scenarios-fast
 
 native:
 	$(PYTHON) native/build.py
 
 test: native
 	$(PYTHON) -m pytest tests/ -x -q
+
+# The adversarial scenario corpus (docs/netsim.md). The fast subset
+# already rides in `tests/` collection (and therefore in ci/tier-1);
+# `scenarios` additionally runs the -m slow soaks, e.g. the
+# million-op virtual-time run. A failing scenario writes a replay
+# dump under .netsim-failures/ with the exact pytest command to
+# reproduce it from its seed.
+scenarios:
+	$(PYTHON) -m pytest tests/scenarios/ -q
+	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/scenarios/ -q \
+		-m 'not slow'
+
+scenarios-fast:
+	$(PYTHON) -m pytest tests/scenarios/ -q -m 'not slow'
 
 # The reference gates check on jsl + jsstyle (reference Makefile:33-41);
 # cblint is the vendored equivalent (tools/cblint.py) and cbfsm the
@@ -32,8 +46,8 @@ fsm-graph:
 # what `make fsm-graph` would write.
 ci: native check docs-check
 	$(PYTHON) tools/cbfsm.py --check-graphs docs/fsm cueball_tpu
-	$(PYTHON) -m pytest tests/ -x -q
-	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -x -q
+	$(PYTHON) -m pytest tests/ -x -q -m 'not slow'
+	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -x -q -m 'not slow'
 	$(MAKE) dryrun
 
 bench:

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import logging
 import math
-import time
 import uuid as mod_uuid
 
 from . import trace as mod_trace
@@ -113,10 +112,9 @@ class ConnectionSet(FSM):
     # -- resolver plumbing ------------------------------------------------
 
     def on_resolver_added(self, k: str, backend: dict) -> None:
-        import random
         backend['key'] = k
         assert k not in self.cs_keys, 'Resolver key is a duplicate'
-        idx = random.randrange(len(self.cs_keys) + 1)
+        idx = mod_utils.get_rng().randrange(len(self.cs_keys) + 1)
         self.cs_keys.insert(idx, k)
         self.cs_backends[k] = backend
         self.rebalance()
@@ -288,11 +286,10 @@ class ConnectionSet(FSM):
     # -- public interface --------------------------------------------------
 
     def reshuffle(self) -> None:
-        import random
         if len(self.cs_keys) <= 1:
             return
         taken = self.cs_keys.pop()
-        idx = random.randrange(len(self.cs_keys) + 1)
+        idx = mod_utils.get_rng().randrange(len(self.cs_keys) + 1)
         if len(self.cs_keys) > self.cs_target and idx < self.cs_target:
             self.cs_log.info('random shuffle puts backend "%s" at idx %d',
                              taken, idx)
@@ -413,7 +410,7 @@ class ConnectionSet(FSM):
             self.add_connection(k)
 
         self.cs_in_rebalance = False
-        self.cs_last_rebalance = time.time()
+        self.cs_last_rebalance = mod_utils.wall_time()
 
     def create_logi_conn(self, key: str) -> None:
         """Allocate the next serial-numbered logical connection for a

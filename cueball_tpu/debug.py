@@ -74,6 +74,13 @@ def dump_fsm_histories(stream=None) -> str:
     buf = io.StringIO()
     buf.write('cueball FSM dump pid=%d t=%.3f stack_traces=%s\n' % (
         os.getpid(), time.time(), mod_utils.stack_traces_enabled()))
+    run_meta = mod_trace.get_run_metadata()
+    if run_meta:
+        # Inside a netsim scenario: name the replayable run this dump
+        # belongs to (seed + scenario identity).
+        buf.write('netsim run: %s\n' % ' '.join(
+            '%s=%s' % (k, run_meta[k]) for k in sorted(run_meta)
+            if k != 'schedule'))
 
     for uuid, pool in list(pool_monitor.pm_pools.items()):
         buf.write('pool %s domain=%s\n' % (uuid, pool.p_domain))

@@ -25,8 +25,9 @@ matching what the resolver's answer-processing expects.
 from __future__ import annotations
 
 import asyncio
-import random
 import struct
+
+from . import utils as mod_utils
 
 # RR type codes
 TYPE_A = 1
@@ -284,6 +285,24 @@ async def query_tcp(resolver: str, port: int, payload: bytes,
         writer.close()
 
 
+class DnsTransport:
+    """Wire-transport seam: how raw query bytes reach a resolver and
+    how raw response bytes come back. The default sends real datagrams
+    and TCP streams on the running asyncio loop; netsim's SimWire
+    (cueball_tpu/netsim/dns.py) substitutes a scripted middlebox so the
+    full _query_wire state machine — EDNS fallback, TC->TCP retry,
+    truncation errors, deadline sharing — runs against hostile answers
+    without a socket (ROADMAP item 5's first consumer)."""
+
+    async def udp(self, resolver: str, port: int, payload: bytes,
+                  timeout_s: float) -> bytes:
+        return await query_udp(resolver, port, payload, timeout_s)
+
+    async def tcp(self, resolver: str, port: int, payload: bytes,
+                  timeout_s: float) -> bytes:
+        return await query_tcp(resolver, port, payload, timeout_s)
+
+
 class DnsClient:
     """Resolver fan-out client (mname-client DnsClient equivalent).
 
@@ -294,8 +313,10 @@ class DnsClient:
     (used by bootstrap resolvers, reference lib/resolver.js:1216-1219).
     """
 
-    def __init__(self, concurrency: int = 3):
+    def __init__(self, concurrency: int = 3,
+                 transport: DnsTransport | None = None):
         self.concurrency = max(1, concurrency)
+        self.transport = transport or DnsTransport()
 
     def lookup(self, opts: dict, cb) -> None:
         asyncio.ensure_future(self._lookup(opts, cb))
@@ -322,32 +343,36 @@ class DnsClient:
                           timeout_s: float) -> DnsMessage:
         host, _, portstr = resolver.partition('@')
         port = int(portstr) if portstr else 53
-        qid = random.randrange(65536)
+        qid = mod_utils.get_rng().randrange(65536)
         payload = build_query(qid, domain, qtype)
         # One DEADLINE for this resolver's whole attempt: the EDNS
         # fallback and the TC->TCP retry each consume what remains,
         # never a fresh slice — otherwise one resolver could stretch
-        # to 3x its budget and stall failover to the next wave.
-        loop = asyncio.get_running_loop()
-        deadline = loop.time() + timeout_s
+        # to 3x its budget and stall failover to the next wave. Read
+        # through the clock seam so netsim's virtual clock (which also
+        # backs the loop's own time()) drives the budget.
+        clk = mod_utils.get_clock()
+        deadline = clk.monotonic() + timeout_s
 
         def left() -> float:
-            return max(deadline - loop.time(), 0.001)
+            return max(deadline - clk.monotonic(), 0.001)
         try:
-            data = await query_udp(host, port, payload, left())
+            data = await self.transport.udp(host, port, payload, left())
             msg = parse_response(data)
             if msg.rcode in ('FORMERR', 'NOTIMP'):
                 # Legacy server/middlebox rejecting the OPT record:
                 # retry once as a plain RFC 1035 query
                 # (RFC 6891 6.2.2). A genuine FORMERR/NOTIMP just
                 # comes back again and propagates below.
-                qid = random.randrange(65536)
+                qid = mod_utils.get_rng().randrange(65536)
                 payload = build_query(qid, domain, qtype,
                                       edns_size=None)
-                data = await query_udp(host, port, payload, left())
+                data = await self.transport.udp(host, port, payload,
+                                                left())
                 msg = parse_response(data)
             if msg.tc:
-                data = await query_tcp(host, port, payload, left())
+                data = await self.transport.tcp(host, port, payload,
+                                                left())
                 msg = parse_response(data)
         except (asyncio.TimeoutError, TimeoutError):
             raise DnsTimeoutError(domain, resolver)
@@ -371,7 +396,7 @@ class DnsClient:
         threshold = opts.get('errorThreshold') or len(resolvers)
         trace = opts.get('trace')
 
-        random.shuffle(resolvers)
+        mod_utils.get_rng().shuffle(resolvers)
         resolvers = resolvers[:threshold]
         errs: list[Exception] = []
 

@@ -34,9 +34,7 @@ from __future__ import annotations
 import logging
 import math
 import os
-import random
 import socket
-import time
 import uuid as mod_uuid
 
 from . import dns_client as mod_nsc
@@ -69,7 +67,7 @@ def _probe_global_v6() -> bool:
 
 
 def have_global_v6() -> bool:
-    now = time.monotonic()
+    now = mod_utils.get_clock().monotonic()
     if _nic_cache['updated'] is None or \
             now - _nic_cache['updated'] > NIC_CACHE_TTL_S:
         _nic_cache['have_v6'] = _probe_global_v6()
@@ -168,7 +166,7 @@ class DNSResolverFSM(FSM):
 
         # Next-refresh deadlines (epoch seconds); normally TTL expiries,
         # error-retry times otherwise (reference lib/resolver.js:330-343).
-        now = time.time()
+        now = mod_utils.wall_time()
         self.r_next_service: float | None = now
         self.r_next_v6: float | None = now
         self.r_next_v4: float | None = now
@@ -323,7 +321,7 @@ class DNSResolverFSM(FSM):
         req = self.resolve(name, 'SRV', self.r_srv_retry['timeout'])
 
         def on_answers(ans, ttl):
-            self.r_next_service = time.time() + ttl
+            self.r_next_service = mod_utils.wall_time() + ttl
             self.r_last_srv_ttl = ttl
             self.r_last_ttl = ttl
             self.r_have_seen_srv = True
@@ -372,7 +370,7 @@ class DNSResolverFSM(FSM):
                     self.r_log.info(
                         'no SRV records for %s; retry in %d seconds',
                         self.r_service, ttl)
-                self.r_next_service = time.time() + ttl
+                self.r_next_service = mod_utils.wall_time() + ttl
                 self._incr_counter('srv-skipped')
                 S.gotoState('aaaa')
             elif code == 'REFUSED':
@@ -398,7 +396,7 @@ class DNSResolverFSM(FSM):
             return
 
         self.r_srvs = [{'name': self.r_domain, 'port': self.r_defport}]
-        d = time.time() + self.r_last_srv_ttl
+        d = mod_utils.wall_time() + self.r_last_srv_ttl
         self.r_next_service = d
 
         # Anti-flap rules (reference lib/resolver.js:687-723): only fall
@@ -413,7 +411,7 @@ class DNSResolverFSM(FSM):
             self.r_log.info(
                 'no SRV records found for service %s, falling back '
                 'to A/AAAA for 15min', self.r_service)
-            self.r_next_service = time.time() + 60 * 15
+            self.r_next_service = mod_utils.wall_time() + 60 * 15
             S.gotoState('aaaa')
             return
 
@@ -434,7 +432,7 @@ class DNSResolverFSM(FSM):
             S.gotoState('aaaa_next')
         else:
             # Re-check after the NIC cache has definitely expired.
-            self.r_next_v6 = time.time() + NIC_CACHE_TTL_S + 0.001
+            self.r_next_v6 = mod_utils.wall_time() + NIC_CACHE_TTL_S + 0.001
             S.gotoState('a')
 
     def state_aaaa_next(self, S):
@@ -461,7 +459,7 @@ class DNSResolverFSM(FSM):
             S.gotoState('aaaa_next')
             return
 
-        now = time.time()
+        now = mod_utils.wall_time()
         if srv.get('expiry_v6') is not None and srv['expiry_v6'] > now:
             if self.r_next_v6 is None or \
                     srv['expiry_v6'] <= self.r_next_v6:
@@ -472,7 +470,7 @@ class DNSResolverFSM(FSM):
         req = self.resolve(srv['name'], 'AAAA', self.r_retry['timeout'])
 
         def on_answers(ans, ttl):
-            d = time.time() + ttl
+            d = mod_utils.wall_time() + ttl
             if self.r_next_v6 is None or d <= self.r_next_v6:
                 self.r_next_v6 = d
             self.r_last_ttl = ttl
@@ -488,7 +486,7 @@ class DNSResolverFSM(FSM):
             if isinstance(err, NoRecordsError) or code == 'NOTIMP':
                 # Name likely has only A records; skip quietly, cached
                 # like the NIC data (reference lib/resolver.js:832-851).
-                srv['expiry_v6'] = time.time() + NIC_CACHE_TTL_S
+                srv['expiry_v6'] = mod_utils.wall_time() + NIC_CACHE_TTL_S
                 S.gotoState('aaaa_next')
                 return
             elif code == 'REFUSED':
@@ -512,7 +510,7 @@ class DNSResolverFSM(FSM):
             if r['delay'] > r['maxDelay']:
                 r['delay'] = r['maxDelay']
         else:
-            d = time.time() + 60 * 60
+            d = mod_utils.wall_time() + 60 * 60
             if self.r_next_v6 is None or d <= self.r_next_v6:
                 self.r_next_v6 = d
             S.gotoState('aaaa_next')
@@ -549,7 +547,7 @@ class DNSResolverFSM(FSM):
             S.gotoState('a_next')
             return
 
-        now = time.time()
+        now = mod_utils.wall_time()
         if srv.get('expiry_v4') is not None and srv['expiry_v4'] > now:
             if self.r_next_v4 is None or \
                     srv['expiry_v4'] <= self.r_next_v4:
@@ -560,7 +558,7 @@ class DNSResolverFSM(FSM):
         req = self.resolve(srv['name'], 'A', self.r_retry['timeout'])
 
         def on_answers(ans, ttl):
-            d = time.time() + ttl
+            d = mod_utils.wall_time() + ttl
             if self.r_next_v4 is None or d <= self.r_next_v4:
                 self.r_next_v4 = d
             self.r_last_ttl = ttl
@@ -603,7 +601,7 @@ class DNSResolverFSM(FSM):
             if r['delay'] > r['maxDelay']:
                 r['delay'] = r['maxDelay']
         else:
-            d = time.time() + self.r_last_ttl
+            d = mod_utils.wall_time() + self.r_last_ttl
             if self.r_next_v4 is None or d <= self.r_next_v4:
                 self.r_next_v4 = d
             S.gotoState('a_next')
@@ -672,7 +670,7 @@ class DNSResolverFSM(FSM):
             S.gotoState('init')
             return
 
-        now = time.time()
+        now = mod_utils.wall_time()
         min_delay = (self.r_next_service or now) - now
         state = 'srv'
         if self.r_next_v6 is not None and \
@@ -693,7 +691,8 @@ class DNSResolverFSM(FSM):
             # cache early just returns the same answer
             # (reference lib/resolver.js:1129-1143).
             d = min_delay * (
-                1 + random.random() * self.r_retry['delaySpread'])
+                1 + mod_utils.get_rng().random() *
+                self.r_retry['delaySpread'])
             self.r_log.debug('sleeping %.2fs until next %s expiry',
                              d, state)
             S.timeout(d * 1000, lambda: S.gotoState(state))

@@ -33,11 +33,11 @@ Semantics replicated:
 from __future__ import annotations
 
 import asyncio
-import time
 import typing
 
 from .events import EventEmitter, _native
 from . import runq
+from . import utils as mod_utils
 
 # Module-level transition trace hooks: fn(fsm, old_state, new_state).
 # The dtrace-probe analogue (reference docs/internals.adoc:125-131):
@@ -370,7 +370,8 @@ class FSM(EventEmitter):
 
         self._fsm_state = state
         self._fsm_history.append(state)
-        self._fsm_history_at.append(time.time() * 1000.0)
+        self._fsm_history_at.append(
+            mod_utils.wall_time() * 1000.0)
         if len(self._fsm_history) > self.HISTORY_LENGTH:
             del self._fsm_history[0]
             del self._fsm_history_at[0]

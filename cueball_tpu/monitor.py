@@ -226,6 +226,9 @@ class PoolMonitor:
             # Ring occupancy + sampling counters (the spans themselves
             # are served raw by GET /kang/traces).
             out['traces'] = mod_trace.summary()
+        run_meta = mod_trace.get_run_metadata()
+        if run_meta:
+            out['netsim_run'] = run_meta
         return out
 
 

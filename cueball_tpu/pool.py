@@ -27,7 +27,6 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
-import time
 import uuid as mod_uuid
 
 from . import codel as mod_codel
@@ -504,9 +503,8 @@ class ConnectionPool(FSM):
         """Insert at a random position in the preference list
         (reference lib/pool.js:285-291; randomized per-client so load
         spreads across the fleet, docs/internals.adoc:275-386)."""
-        import random
         backend['key'] = k
-        idx = random.randrange(len(self.p_keys) + 1)
+        idx = mod_utils.get_rng().randrange(len(self.p_keys) + 1)
         self.p_keys.insert(idx, k)
         self.p_backends[k] = backend
         self.rebalance()
@@ -707,11 +705,10 @@ class ConnectionPool(FSM):
         """Decoherence shuffle: move a random preference entry so
         per-client orderings decorrelate over time
         (reference lib/pool.js:501-519)."""
-        import random
         if len(self.p_keys) <= 1:
             return
         taken = self.p_keys.pop()
-        idx = random.randrange(len(self.p_keys) + 1)
+        idx = mod_utils.get_rng().randrange(len(self.p_keys) + 1)
         conns = sum(len(v) for v in self.p_connections.values())
         if len(self.p_keys) > conns and idx < conns:
             self.p_log.info('random shuffle puts backend "%s" at idx %d',
@@ -782,7 +779,7 @@ class ConnectionPool(FSM):
                 'spares = %d, target = %d)', len(plan['remove']),
                 len(plan['add']), busy, spares, target)
 
-        now = time.time()
+        now = mod_utils.wall_time()
         rate_delay = None
 
         for fsm in plan['remove']:
@@ -836,7 +833,7 @@ class ConnectionPool(FSM):
                 (rate_delay * 1000 + 10) / 1000.0, self.rebalance)
 
         self.p_in_rebalance = False
-        self.p_last_rebalance = time.time()
+        self.p_last_rebalance = mod_utils.wall_time()
 
     def add_connection(self, key: str) -> None:
         """Create a slot for `key` and wire the pool's slot stateChanged

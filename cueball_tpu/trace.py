@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import collections
 import json
-import random
 
 from . import utils as mod_utils
 
@@ -69,11 +68,11 @@ POOL_GAUGES = {
 
 
 def _new_trace_id() -> str:
-    return '%032x' % random.getrandbits(128)
+    return '%032x' % mod_utils.get_rng().getrandbits(128)
 
 
 def _new_span_id() -> str:
-    return '%016x' % random.getrandbits(64)
+    return '%016x' % mod_utils.get_rng().getrandbits(64)
 
 
 class Span:
@@ -357,7 +356,7 @@ class _TraceRuntime:
         elif rate <= 0.0:
             sampled = False
         else:
-            sampled = random.random() < rate
+            sampled = mod_utils.get_rng().random() < rate
         if sampled:
             self.tr_sampled += 1
         return sampled
@@ -532,18 +531,38 @@ def export_ndjson() -> str:
     return '\n'.join(lines) + '\n' if lines else ''
 
 
+# Identity of the current netsim scenario run (seed, name, schedule),
+# attached by netsim.scenario so every export surface — summary(),
+# the SIGUSR2 dump, kang snapshots — names the exact replayable run
+# its numbers came from. Empty outside simulation.
+_run_metadata: dict = {}
+
+
+def set_run_metadata(meta: dict | None) -> None:
+    global _run_metadata
+    _run_metadata = dict(meta or {})
+
+
+def get_run_metadata() -> dict:
+    return dict(_run_metadata)
+
+
 def summary() -> dict:
     runtime = _runtime
     if runtime is None:
-        return {'enabled': False}
-    return {
-        'enabled': True,
-        'ring': len(runtime.tr_ring),
-        'ring_size': runtime.tr_ring.maxlen,
-        'sample_rate': runtime.tr_sample,
-        'seen': runtime.tr_seen,
-        'sampled': runtime.tr_sampled,
-    }
+        out = {'enabled': False}
+    else:
+        out = {
+            'enabled': True,
+            'ring': len(runtime.tr_ring),
+            'ring_size': runtime.tr_ring.maxlen,
+            'sample_rate': runtime.tr_sample,
+            'seen': runtime.tr_seen,
+            'sampled': runtime.tr_sampled,
+        }
+    if _run_metadata:
+        out['run'] = dict(_run_metadata)
+    return out
 
 
 def dump_traces(limit: int = 8) -> str:

@@ -234,18 +234,80 @@ def assert_claim_delay(delay) -> None:
 
 
 # ---------------------------------------------------------------------------
-# Clock / randomness helpers
+# Clock / randomness seams
+#
+# Every time read and every random draw the framework makes goes through
+# these two process-wide injection points. The defaults are exactly the
+# historical behaviour (time.monotonic/time.time and the global `random`
+# module, so `random.seed()` still pins the stream the way
+# tests/test_runq_conformance.py relies on). The netsim virtual-time
+# fabric (cueball_tpu/netsim/) swaps in a VirtualClock plus a seeded
+# random.Random so a scenario seed fully determines a run; see
+# docs/netsim.md.
+
+class SystemClock:
+    """Default clock: real monotonic + wall time."""
+
+    def monotonic(self) -> float:
+        """Seconds, monotonic (time origin unspecified)."""
+        return time.monotonic()
+
+    def wall(self) -> float:
+        """Seconds since the epoch (time.time)."""
+        return time.time()
+
+
+_clock = SystemClock()
+_rng = random  # module default: the global `random` stream
+
+
+def set_clock(clock) -> object:
+    """Install a process-wide clock (an object with .monotonic() and
+    .wall(), both in seconds); returns the previous clock so callers
+    can restore it in a finally block."""
+    global _clock
+    old = _clock
+    _clock = clock
+    return old
+
+
+def get_clock():
+    return _clock
+
+
+def set_rng(rng) -> object:
+    """Install the process-wide RNG (random.Random-compatible: random /
+    randrange / getrandbits / shuffle); returns the previous one. All
+    framework randomness — backoff jitter, pool preference inserts,
+    DNS resolver shuffle and qid draws, trace ids — flows through
+    this seam."""
+    global _rng
+    old = _rng
+    _rng = rng
+    return old
+
+
+def get_rng():
+    return _rng
+
 
 def current_millis() -> float:
-    """Monotonic time in milliseconds (reference lib/utils.js:198-204)."""
-    return time.monotonic() * 1000.0
+    """Monotonic time in milliseconds (reference lib/utils.js:198-204),
+    read through the pluggable clock seam."""
+    return _clock.monotonic() * 1000.0
+
+
+def wall_time() -> float:
+    """Epoch seconds through the pluggable clock seam (the `time.time()`
+    every scheduling deadline in the framework uses)."""
+    return _clock.wall()
 
 
 def shuffle(array: list) -> list:
     """In-place Fisher-Yates shuffle (reference lib/utils.js:207-217)."""
     i = len(array)
     while i > 0:
-        j = random.randrange(i)
+        j = _rng.randrange(i)
         i -= 1
         array[i], array[j] = array[j], array[i]
     return array
@@ -262,7 +324,7 @@ def gen_delay(recov_or_delay, spread: float | None = None) -> int:
     _chk(_is_num(base), 'base delay must be a number')
     if spread is None:
         spread = 0.2
-    return round(base * (1 - spread / 2.0 + random.random() * spread))
+    return round(base * (1 - spread / 2.0 + _rng.random() * spread))
 
 
 delay = gen_delay

@@ -4,6 +4,12 @@ queried domain, records query history for exact-sequence assertions, and
 exposes mutable globals (use_a2, srv_ttl) to script topology/TTL changes
 mid-test.
 
+Now a thin shim over the netsim scripted-DNS primitive
+(cueball_tpu/netsim/dns.py ScriptedDnsClient): this file only supplies
+the convention table as a script function returning DnsOutcome
+objects; delivery scheduling, history recording, and error synthesis
+live in netsim.
+
 Conventions (domain suffix decides behavior):
   *.ok        - 'srv.ok' SRV -> [a.ok:111, aaaa.ok:111] (+a2.ok if use_a2);
                 'dupe.ok' SRV -> duplicate targets; 'a.ok'/A -> 1.2.3.4;
@@ -15,7 +21,7 @@ Conventions (domain suffix decides behavior):
   *.timeout   - times out after opts['timeout']
 """
 
-from cueball_tpu.dns_client import DnsError, DnsMessage, DnsTimeoutError
+from cueball_tpu.netsim import DnsOutcome, ScriptedDnsClient
 
 
 class Cfg:
@@ -33,34 +39,30 @@ def _rr(name, rtype, ttl, target, port=None):
             'port': port}
 
 
-class FakeDnsClient:
+def _is_srv(parts, qtype):
+    return len(parts) > 2 and parts[2] in ('_tcp', '_udp') and \
+        qtype == 'SRV'
+
+
+class FakeDnsClient(ScriptedDnsClient):
     instances = []
 
     def __init__(self, concurrency=3):
-        self.history = []
+        super().__init__()
         FakeDnsClient.instances.append(self)
 
-    def lookup(self, opts, cb):
-        import asyncio
-        loop = asyncio.get_running_loop()
-
+    def script(self, opts):
         domain = opts['domain']
         qtype = opts['type']
-        self.history.append(opts)
-
         parts = domain.split('.')[::-1]
         answers = []
         authority = []
-        err = None
 
         tld = parts[0]
         if Cfg.srv_refuse and qtype == 'SRV':
-            msg = DnsMessage(1234, 'NOERROR', False, [], [], [])
-            loop.call_soon(cb, DnsError('SERVFAIL', domain), msg)
-            return
+            return DnsOutcome(rcode='SERVFAIL')
         if tld == 'ok':
-            if len(parts) > 2 and parts[1] == 'srv' and \
-                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+            if parts[1] == 'srv' and _is_srv(parts, qtype):
                 answers.append(_rr(domain, 'SRV', Cfg.srv_ttl, 'a.ok',
                                    111))
                 answers.append(_rr(domain, 'SRV', Cfg.srv_ttl, 'aaaa.ok',
@@ -68,8 +70,7 @@ class FakeDnsClient:
                 if Cfg.use_a2:
                     answers.append(_rr(domain, 'SRV', Cfg.srv_ttl,
                                        'a2.ok', 111))
-            elif len(parts) > 2 and parts[1] == 'dupe' and \
-                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+            elif parts[1] == 'dupe' and _is_srv(parts, qtype):
                 answers.append(_rr(domain, 'SRV', Cfg.srv_ttl, 'dupe.ok',
                                    112))
                 if Cfg.use_a2:
@@ -89,22 +90,21 @@ class FakeDnsClient:
             elif parts[1] in ('a', 'aaaa', 'a2', 'dupe'):
                 pass  # NODATA
             else:
-                err = DnsError('NXDOMAIN', domain)
+                return DnsOutcome(rcode='NXDOMAIN')
         elif tld == 'notfound':
-            err = DnsError('NXDOMAIN', domain)
+            return DnsOutcome(rcode='NXDOMAIN')
         elif tld == 'notimp':
-            if len(parts) > 2 and parts[1] == 'srv' and \
-                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+            if parts[1] == 'srv' and _is_srv(parts, qtype):
                 answers.append(_rr(domain, 'SRV', 3600, 'a.notimp', 111))
             else:
-                err = DnsError('NOTIMP', domain)
+                return DnsOutcome(rcode='NOTIMP')
         elif tld == 'short-ttl':
             if parts[1] == 'a' and qtype == 'A':
                 answers.append(_rr(domain, 'A', 1, '1.2.3.4'))
             else:
                 # Default rcode stays NXDOMAIN (reference fake leaves the
                 # initial rcode untouched off the matching branches).
-                err = DnsError('NXDOMAIN', domain)
+                return DnsOutcome(rcode='NXDOMAIN')
         elif tld == 'soa-ttl':
             # NODATA carrying an SOA minimum TTL (newer-binder behavior,
             # reference lib/resolver.js:1266-1279).
@@ -115,33 +115,31 @@ class FakeDnsClient:
         elif tld == 'flaky':
             # Transient SERVFAILs: Cfg.flaky_fails[qtype] failures, then
             # answers — drives the aaaa_error/a_error retry ladders.
-            if len(parts) > 2 and parts[1] == 'srv' and \
-                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+            if parts[1] == 'srv' and _is_srv(parts, qtype):
                 answers.append(_rr(domain, 'SRV', Cfg.srv_ttl,
                                    'host.flaky', 113))
             elif parts[1] == 'host' and \
                     Cfg.flaky_fails.get(qtype, 0) > 0:
                 Cfg.flaky_fails[qtype] -= 1
-                err = DnsError('SERVFAIL', domain)
+                return DnsOutcome(rcode='SERVFAIL')
             elif parts[1] == 'host' and qtype == 'AAAA':
                 answers.append(_rr(domain, 'AAAA', 3600, 'fd00::5'))
             elif parts[1] == 'host' and qtype == 'A':
                 answers.append(_rr(domain, 'A', 3600, '1.2.3.7'))
             else:
-                err = DnsError('NXDOMAIN', domain)
+                return DnsOutcome(rcode='NXDOMAIN')
         elif tld == 'refused':
             # AAAA lookups REFUSED (fast-fail, no retry ladder); SRV and
             # A behave normally.
-            if len(parts) > 2 and parts[1] == 'srv' and \
-                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+            if parts[1] == 'srv' and _is_srv(parts, qtype):
                 answers.append(_rr(domain, 'SRV', Cfg.srv_ttl,
                                    'host.refused', 114))
             elif parts[1] == 'host' and qtype == 'AAAA':
-                err = DnsError('REFUSED', domain)
+                return DnsOutcome(rcode='REFUSED')
             elif parts[1] == 'host' and qtype == 'A':
                 answers.append(_rr(domain, 'A', 3600, '1.2.3.8'))
             else:
-                err = DnsError('NXDOMAIN', domain)
+                return DnsOutcome(rcode='NXDOMAIN')
         elif tld == 'srvref':
             # SRV queries REFUSED outright (an authoritative server
             # refusing recursion for records outside its authority,
@@ -149,36 +147,28 @@ class FakeDnsClient:
             # as name-not-known — no retry ladder, straight fall
             # through to plain-name A/AAAA on the base domain.
             if qtype == 'SRV':
-                err = DnsError('REFUSED', domain)
+                return DnsOutcome(rcode='REFUSED')
             elif parts[1] == 'srv' and qtype == 'A':
                 answers.append(_rr(domain, 'A', 3600, '1.2.3.21'))
             elif parts[1] == 'srv' and qtype == 'AAAA':
                 pass  # NODATA
             else:
-                err = DnsError('NXDOMAIN', domain)
+                return DnsOutcome(rcode='NXDOMAIN')
         elif tld == 'addl':
             # SRV answers carrying A+AAAA additionals for their target:
             # the resolver must use them and skip the address lookups
             # entirely (reference lib/resolver.js:1318-1343).
-            if len(parts) > 2 and parts[1] == 'srv' and \
-                    parts[2] in ('_tcp', '_udp') and qtype == 'SRV':
+            if parts[1] == 'srv' and _is_srv(parts, qtype):
                 answers.append(_rr(domain, 'SRV', Cfg.srv_ttl,
                                    'host.addl', 115))
-                additionals = [
+                return DnsOutcome(answers=answers, additionals=[
                     _rr('host.addl', 'A', 3600, '1.2.3.11'),
                     _rr('host.addl', 'AAAA', 3600, 'fd00::11'),
-                ]
-                msg = DnsMessage(1234, 'NOERROR', False, answers, [],
-                                 additionals)
-                loop.call_soon(cb, None, msg)
-                return
-            err = DnsError('NXDOMAIN', domain)
+                ])
+            return DnsOutcome(rcode='NXDOMAIN')
         elif tld == 'timeout':
-            loop.call_later(opts['timeout'] / 1000.0, cb,
-                            DnsTimeoutError(domain), None)
-            return
+            return DnsOutcome(timeout=True)
         else:
             raise RuntimeError('wat: %s' % domain)
 
-        msg = DnsMessage(1234, 'NOERROR', False, answers, authority, [])
-        loop.call_soon(cb, err, msg)
+        return DnsOutcome(answers=answers, authority=authority)

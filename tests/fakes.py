@@ -1,38 +1,38 @@
 """Shared test fakes, mirroring the reference suite's fixtures:
 DummyConnection with manually-driven connect/error/close
-(reference test/pool.test.js:69-98) and a minimal pool stand-in."""
+(reference test/pool.test.js:69-98) and a minimal pool stand-in.
 
-from cueball_tpu.events import EventEmitter
+DummyConnection is now a thin shim over the netsim fabric's
+ManualConnection primitive (cueball_tpu/netsim/fabric.py): identical
+manually-driven surface (connect()/emit, dead/refd/connected,
+instances registry), but registered with a shared Fabric so fault
+schedules (partition/down/gray) reach test-driven connections too."""
+
+from cueball_tpu.netsim import Fabric, ManualConnection
+
+# One fabric for all manually-driven test connections; tests that want
+# fault injection reach it via DummyConnection.fabric.
+_FABRIC = Fabric()
 
 
-class DummyConnection(EventEmitter):
+class DummyConnection(ManualConnection):
     """Connection-interface object whose lifecycle is driven by the test:
     nothing happens until the test calls connect()/emit."""
 
     instances = []
 
-    def __init__(self, backend):
-        super().__init__()
-        self.backend = backend
-        self.refd = True
-        self.connected = False
-        self.dead = False
+    def __init__(self, backend, fabric=None):
+        super().__init__(fabric or _FABRIC, backend)
         DummyConnection.instances.append(self)
 
-    def connect(self):
-        assert self.dead is False
-        self.connected = True
-        self.emit('connect')
-
-    def unref(self):
-        self.refd = False
-
-    def ref(self):
-        self.refd = True
-
     def destroy(self):
+        # Legacy contract: destroy() marks the object dead without
+        # emitting 'close' — the test decides what events fire.
+        if self.dead:
+            return
         self.dead = True
         self.connected = False
+        self.fabric._unregister(self)
 
 
 class FakePool:

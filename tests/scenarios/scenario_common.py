@@ -1,0 +1,144 @@
+"""Shared plumbing for the adversarial scenario corpus.
+
+Every scenario builds the same shape: a ConnectionPool wired over a
+netsim Fabric via the constructor seam, backends named by region
+(``r<region>-b<n>``), recovery tuned so fault->recover cycles complete
+in seconds of VIRTUAL time. Helpers here issue claims through the real
+claim_cb path and wait for pool states on virtual sleeps.
+
+Scenario files import this module directly (pytest puts this directory
+on sys.path); they deliberately do NOT import tests/conftest.py
+helpers, which assume the real loop."""
+
+import asyncio
+
+from cueball_tpu import netsim
+from cueball_tpu.pool import ConnectionPool
+from cueball_tpu.resolver import StaticIpResolver
+
+RECOVERY = {'default': {'retries': 2, 'timeout': 500, 'delay': 100,
+                        'maxDelay': 400, 'delaySpread': 0.2}}
+
+
+def region_backends(regions: int = 3, per_region: int = 3,
+                    port: int = 80) -> list[dict]:
+    out = []
+    for r in range(1, regions + 1):
+        for b in range(1, per_region + 1):
+            out.append({'key': 'r%d-b%d' % (r, b),
+                        'address': '10.%d.0.%d' % (r, b),
+                        'port': port})
+    return out
+
+
+def backend_keys(pool) -> list[str]:
+    return list(pool.p_keys)
+
+
+def fabric_key(backend: dict) -> str:
+    """The 'address:port' alias the fabric resolves alongside the
+    pool's opaque hashed backend key — how scenarios name backends
+    when driving faults."""
+    return '%s:%s' % (backend['address'], backend['port'])
+
+
+def make_sim_pool(fabric: netsim.Fabric, backends: list[dict],
+                  spares: int = 2, maximum: int = 8,
+                  recovery: dict | None = None, **opts):
+    """Pool over the fabric. Returns (pool, resolver); caller runs
+    inside a netsim loop."""
+    res = StaticIpResolver({'backends': [
+        {'address': b['address'], 'port': b['port']}
+        for b in backends]})
+    options = {
+        'domain': 'svc.sim',
+        'constructor': fabric.constructor,
+        'resolver': res,
+        'spares': spares,
+        'maximum': maximum,
+        'recovery': recovery or RECOVERY,
+    }
+    options.update(opts)
+    pool = ConnectionPool(options)
+    res.start()
+    return pool, res
+
+
+def key_for(pool, backend_key_prefix: str) -> list[str]:
+    return [k for k in pool.p_keys
+            if pool.p_backends[k]['address'].startswith(
+                backend_key_prefix)]
+
+
+def region_keys(pool, region: int) -> list[str]:
+    """Pool backend keys whose address is in 10.<region>.0.0/16."""
+    return key_for(pool, '10.%d.' % region)
+
+
+async def claim_once(pool, timeout_ms: float = 1000.0):
+    """One claim through the real callback path -> (err, hdl, conn)."""
+    fut = asyncio.get_running_loop().create_future()
+
+    def cb(err, hdl=None, conn=None):
+        if not fut.done():
+            fut.set_result((err, hdl, conn))
+    pool.claim_cb({'timeout': timeout_ms}, cb)
+    return await fut
+
+
+async def claim_release(pool, timeout_ms: float = 1000.0,
+                        hold_s: float = 0.0) -> bool:
+    err, hdl, conn = await claim_once(pool, timeout_ms)
+    if err is not None:
+        return False
+    listener = conn.on('error', lambda e=None: None)
+    if hold_s > 0:
+        await asyncio.sleep(hold_s)
+    conn.remove_listener('error', listener)
+    try:
+        hdl.release()
+    except Exception:
+        return False
+    return True
+
+
+async def wait_state(fsm, state: str, timeout_s: float = 30.0) -> None:
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while not fsm.is_in_state(state):
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError(
+                'timed out waiting for %r (in %r)' % (
+                    state, fsm.get_state()))
+        await asyncio.sleep(0.05)
+
+
+async def stop_pool(pool, resolver=None) -> None:
+    pool.stop()
+    await wait_state(pool, 'stopped', timeout_s=60.0)
+    if resolver is not None and hasattr(resolver, 'stop'):
+        try:
+            resolver.stop()
+        except Exception:
+            pass
+        await asyncio.sleep(0.2)
+
+
+async def measure_recovery_s(pool, timeout_ms: float = 500.0,
+                             probe_every_s: float = 0.1,
+                             needed_ok: int = 3,
+                             give_up_s: float = 60.0) -> float:
+    """Virtual seconds until ``needed_ok`` consecutive claims succeed:
+    the scenario-level definition of 'recovered'."""
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    streak = 0
+    while True:
+        if loop.time() - t0 > give_up_s:
+            raise AssertionError(
+                'pool did not recover within %.1fs virtual'
+                % give_up_s)
+        ok = await claim_release(pool, timeout_ms)
+        streak = streak + 1 if ok else 0
+        if streak >= needed_ok:
+            return loop.time() - t0
+        await asyncio.sleep(probe_every_s)

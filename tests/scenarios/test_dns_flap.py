@@ -1,0 +1,135 @@
+"""Scenario: DNS TTL flapping under a mutating zone.
+
+A real DNSResolver polls a SimZone through a ScriptedDnsClient with
+1-second TTLs. The zone mutates mid-run — a backend joins, another is
+retired — and then the nameserver SERVFAILs for a 2-second window.
+
+Envelope:
+
+- each zone mutation is reflected in the resolver's backend set
+  within 3 virtual seconds (TTL + one retry of slack);
+- the SERVFAIL window causes NO removals: the resolver must serve
+  stale-but-recent data on refresh errors, not dump the backend list;
+- after the window the resolver is still 'running' and converged.
+"""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu import netsim
+from cueball_tpu.dns_resolver import DNSResolver
+
+SRV = '_svc._tcp.svc.flap'
+RECOVERY = {'default': {'retries': 2, 'timeout': 400, 'delay': 100,
+                        'maxDelay': 300, 'delaySpread': 0.2}}
+
+
+class ZoneScriptClient(netsim.ScriptedDnsClient):
+    """Client-level view of a SimZone, with a SERVFAIL window."""
+
+    def __init__(self, zone):
+        super().__init__()
+        self.zone = zone
+        self.fail_until = None      # virtual time, None = healthy
+
+    def script(self, opts):
+        now = asyncio.get_running_loop().time()
+        if self.fail_until is not None and now < self.fail_until:
+            return netsim.DnsOutcome(rcode='SERVFAIL')
+        rcode, answers, authority = self.zone.resolve(
+            opts['domain'], opts['type'])
+        if rcode != 'NOERROR':
+            return netsim.DnsOutcome(rcode=rcode)
+        return netsim.DnsOutcome(answers=answers, authority=authority)
+
+
+async def _converge(addrs, expected, deadline_s):
+    loop = asyncio.get_running_loop()
+    while loop.time() < deadline_s:
+        if set(addrs.values()) == expected:
+            return loop.time()
+        await asyncio.sleep(0.1)
+    raise AssertionError('no convergence to %r by t=%.1fs (have %r)'
+                         % (expected, deadline_s, addrs))
+
+
+@pytest.mark.parametrize('seed', [3, 555])
+def test_dns_flap_convergence_and_stale_serving(seed):
+    zone = netsim.SimZone()
+    zone.add_srv_backend(SRV, 'b1.flap', 8080, '10.9.0.1',
+                         ttl=1, addr_ttl=1)
+    zone.add_srv_backend(SRV, 'b2.flap', 8080, '10.9.0.2',
+                         ttl=1, addr_ttl=1)
+    client = ZoneScriptClient(zone)
+    sc = netsim.Scenario('dns-flap', seed=seed)
+    result = {}
+
+    async def main():
+        res = DNSResolver({
+            'domain': 'svc.flap', 'service': '_svc._tcp',
+            'defaultPort': 8080, 'resolvers': ['9.9.9.1'],
+            'recovery': RECOVERY, 'dnsClient': client,
+        })
+        addrs = {}
+        removals = []
+
+        def on_added(k, b):
+            addrs[k] = b['address']
+
+        def on_removed(k):
+            removals.append((asyncio.get_running_loop().time(), k))
+            addrs.pop(k, None)
+        res.on('added', on_added)
+        res.on('removed', on_removed)
+        res.start()
+
+        sc.at(3.0, 'join-b3', lambda: zone.add_srv_backend(
+            SRV, 'b3.flap', 8080, '10.9.0.3', ttl=1, addr_ttl=1))
+
+        def retire_b1():
+            zone.remove(SRV, 'SRV')
+            zone.add(SRV, 'SRV', 'b2.flap', ttl=1, port=8080)
+            zone.add(SRV, 'SRV', 'b3.flap', ttl=1, port=8080)
+        sc.at(6.0, 'retire-b1', retire_b1)
+
+        def open_window():
+            client.fail_until = 11.0
+        sc.at(9.0, 'servfail-window', open_window)
+
+        await _converge(addrs, {'10.9.0.1', '10.9.0.2'}, 3.0)
+        t_joined = await _converge(
+            addrs, {'10.9.0.1', '10.9.0.2', '10.9.0.3'}, 6.0)
+        t_retired = await _converge(
+            addrs, {'10.9.0.2', '10.9.0.3'}, 9.0)
+
+        # Across the SERVFAIL window: stale data keeps being served.
+        loop = asyncio.get_running_loop()
+        while loop.time() < 12.0:
+            await asyncio.sleep(0.2)
+        window_removals = [r for r in removals if 9.0 <= r[0] <= 12.0]
+        result.update({
+            't_joined': t_joined, 't_retired': t_retired,
+            'window_removals': window_removals,
+            'final': set(addrs.values()),
+            'running': res.is_in_state('running'),
+            'queries': len(client.history),
+        })
+        res.stop()
+        deadline = loop.time() + 10.0
+        while loop.time() < deadline and \
+                not res.is_in_state('stopped'):
+            await asyncio.sleep(0.1)
+
+    sc.run(lambda: main())
+
+    assert result['t_joined'] - 3.0 < 3.0, result
+    assert result['t_retired'] - 6.0 < 3.0, result
+    assert result['window_removals'] == [], result
+    assert result['final'] == {'10.9.0.2', '10.9.0.3'}, result
+    assert result['running'], result
+    # 1-second TTLs over 12 virtual seconds: the resolver re-queried
+    # constantly; the scenario cost essentially no wall time.
+    assert result['queries'] > 20, result
+    assert [l for _, l in sc.fired] == \
+        ['join-b3', 'retire-b1', 'servfail-window']

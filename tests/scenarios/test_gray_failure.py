@@ -1,0 +1,64 @@
+"""Scenario: gray failure.
+
+Ten backends, each serving at 2ms; at t=2s, 20% of them silently turn
+100x slower (200ms per request) WITHOUT failing — the classic gray
+failure no health check catches. Poisson claim traffic holds each
+lease for one simulated request on the claimed backend.
+
+Envelope, p99-style like test_pool_codel's ±175ms pin:
+
+- p50 claim latency stays sub-10ms (healthy capacity dominates);
+- p99 claim latency stays bounded by the gray service time plus a
+  scheduling allowance — gray backends slow SOME claims (a claim that
+  queued behind a gray lease waits for it) but must not collapse the
+  pool;
+- overall success rate stays >= 99%: gray is slow, not down.
+"""
+
+import pytest
+
+from cueball_tpu import netsim
+
+import scenario_common as sco
+
+
+@pytest.mark.parametrize('seed', [5, 909])
+def test_gray_failure_p99_claim_latency_envelope(seed):
+    fabric = netsim.Fabric()
+    sc = netsim.Scenario('gray-failure', seed=seed)
+    result = {}
+
+    async def main():
+        backends = sco.region_backends(regions=1, per_region=10)
+        for b in backends:
+            fabric.set_link(sco.fabric_key(b), service_ms=2.0)
+        pool, res = sco.make_sim_pool(fabric, backends, spares=6,
+                                      maximum=10)
+        await sco.wait_state(pool, 'running', timeout_s=10.0)
+
+        sc.at(2.0, 'gray-20pct',
+              lambda: result.__setitem__(
+                  'gray_keys', fabric.set_gray(0.2, mult=100.0)))
+
+        outcomes = await netsim.herd(
+            pool, 400, rate_per_s=40.0, timeout_ms=2000)
+        result['outcomes'] = outcomes
+        await sco.stop_pool(pool, res)
+
+    sc.run(lambda: main())
+
+    outcomes = result['outcomes']
+    lats = [r['latency_ms'] for r in outcomes
+            if r['latency_ms'] is not None]
+    ok_rate = sum(1 for r in outcomes if r['ok']) / len(outcomes)
+    p50 = netsim.quantile(lats, 0.50)
+    p99 = netsim.quantile(lats, 0.99)
+
+    assert len(result['gray_keys']) == 2
+    assert ok_rate >= 0.99, (ok_rate, p50, p99)
+    assert p50 < 10.0, (ok_rate, p50, p99)
+    # One gray service time (200ms) + one healthy-queue drain
+    # allowance; a pool that piles claims onto gray backends blows
+    # straight through this.
+    assert p99 < 450.0, (ok_rate, p50, p99)
+    assert len(sc.trace) > 100

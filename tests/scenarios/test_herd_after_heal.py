@@ -1,0 +1,86 @@
+"""Scenario: thundering herd after a partition heals.
+
+Every backend partitions at t=2s; the pool exhausts its retries and
+declares 'failed'. At t=10s the fabric heals; once the pool claws its
+way back to 'running', 300 clients from three equal cohorts arrive in
+a single burst — far more work than 6 connections can serve inside
+the claim timeout, so the pool MUST shed. What matters is how.
+
+Envelope:
+
+- the pool recovers from 'failed' to 'running' within 3 virtual
+  seconds of the heal (retry backoff is capped at 400ms);
+- shed fairness: per-cohort success rates have a Jain index >= 0.98 —
+  the queue must shed by arrival order, not starve a cohort;
+- the shed is real but bounded: overall success rate lands in the
+  capacity-derived band (6 conns x 50ms holds x 1s timeout serves
+  roughly 120 of 300), and every failure is a claim timeout, not a
+  pool error;
+- post-herd steady state: a fresh claim succeeds immediately.
+"""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu import netsim
+from cueball_tpu.errors import ClaimTimeoutError
+
+import scenario_common as sco
+
+
+@pytest.mark.parametrize('seed', [21, 777])
+def test_herd_after_heal_shed_fairness(seed):
+    fabric = netsim.Fabric()
+    sc = netsim.Scenario('herd-after-heal', seed=seed)
+    result = {}
+
+    async def main():
+        backends = sco.region_backends(regions=1, per_region=6)
+        pool, res = sco.make_sim_pool(fabric, backends, spares=4,
+                                      maximum=6)
+        await sco.wait_state(pool, 'running', timeout_s=10.0)
+        loop = asyncio.get_running_loop()
+
+        all_keys = [sco.fabric_key(b) for b in backends]
+        sc.at(2.0, 'partition-all',
+              lambda: fabric.partition(all_keys))
+        sc.at(10.0, 'heal-all', lambda: fabric.heal())
+
+        # The full partition must drive the pool to 'failed'.
+        await sco.wait_state(pool, 'failed', timeout_s=9.0)
+        result['went_failed'] = True
+
+        while loop.time() < 10.0:
+            await asyncio.sleep(0.05)
+        await sco.wait_state(pool, 'running', timeout_s=10.0)
+        result['recovered_at_s'] = loop.time()
+
+        # The herd hits while the pool is barely back on its feet.
+        outcomes = await netsim.herd(
+            pool, 300, timeout_ms=1000, hold_s=0.05,
+            cohort=lambda i: 'c%d' % (i % 3))
+        result['outcomes'] = outcomes
+        result['steady_claim'] = await sco.claim_release(pool, 1000)
+        await sco.stop_pool(pool, res)
+
+    sc.run(lambda: main())
+
+    outcomes = result['outcomes']
+    rates = netsim.success_rates(outcomes)
+    fairness = netsim.jain_index(rates.values())
+    ok_rate = sum(1 for r in outcomes if r['ok']) / len(outcomes)
+    errs = {r['err'] for r in outcomes if not r['ok']}
+
+    assert result['went_failed']
+    assert result['recovered_at_s'] - 10.0 < 3.0, result
+    assert set(rates) == {'c0', 'c1', 'c2'}
+    assert fairness >= 0.98, (fairness, rates)
+    # Capacity math: 6 conns x ~20 claims/s each x 1s timeout ~ 120
+    # served; the rest shed by timeout. Band is generous on both
+    # sides but rules out 'served everything' and 'served nothing'.
+    assert 0.20 <= ok_rate <= 0.80, (ok_rate, rates)
+    assert errs == {ClaimTimeoutError.__name__}, errs
+    assert result['steady_claim']
+    assert [l for _, l in sc.fired] == ['partition-all', 'heal-all']
+    assert len(sc.trace) > 100

@@ -1,0 +1,174 @@
+"""DNS middlebox behavior against the REAL DnsClient.
+
+These are the _query_wire failure branches that had no coverage
+before the DnsTransport seam existed: the EDNS-rejecting legacy
+middlebox (FORMERR/NOTIMP -> plain RFC 1035 retry, RFC 6891 6.2.2),
+the TC-bit truncation -> TCP retry, cut-off packets surfacing as
+parse errors rather than killing the lookup task, blackholed
+resolvers consuming only their own deadline slice, and the shared
+per-resolver deadline across fallback retries. The middlebox is
+netsim's SimWire serving a SimZone; the client under test is the real
+cueball_tpu.dns_client.DnsClient, wire bytes and all."""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu import netsim
+from cueball_tpu.dns_client import (DnsClient, DnsError,
+                                    DnsTimeoutError, MultiError)
+
+
+def _zone():
+    zone = netsim.SimZone()
+    zone.add('a.sim', 'A', '1.2.3.4', ttl=30)
+    zone.add('big.sim', 'A', '10.0.0.7', ttl=30)
+    zone.add_srv_backend('_svc._tcp.sim', 'b1.sim', 8080, '10.1.0.1')
+    return zone
+
+
+async def _lookup(client, domain, qtype, resolvers, timeout=1000):
+    fut = asyncio.get_running_loop().create_future()
+    client.lookup({'domain': domain, 'type': qtype,
+                   'timeout': timeout, 'resolvers': resolvers},
+                  lambda e, m: fut.set_result((e, m)))
+    return await fut
+
+
+def test_edns_formerr_middlebox_triggers_plain_retry():
+    async def main():
+        wire = netsim.SimWire(_zone(),
+                              behaviors={'9.9.9.1': 'formerr-edns'})
+        client = DnsClient(transport=wire)
+        err, msg = await _lookup(client, 'a.sim', 'A', ['9.9.9.1'])
+        assert err is None
+        assert msg.get_answers()[0]['target'] == '1.2.3.4'
+        # Exactly two UDP queries: the EDNS one that got FORMERR and
+        # the plain RFC 1035 retry that was answered.
+        assert [e[0] for e in wire.log] == ['udp', 'udp']
+        return True
+
+    assert netsim.run(main(), seed=1)
+
+
+def test_edns_notimp_middlebox_triggers_plain_retry():
+    async def main():
+        wire = netsim.SimWire(_zone(),
+                              behaviors={'9.9.9.1': 'notimp-edns'})
+        client = DnsClient(transport=wire)
+        err, msg = await _lookup(client, 'a.sim', 'A', ['9.9.9.1'])
+        assert err is None
+        assert msg.get_answers()[0]['target'] == '1.2.3.4'
+        return True
+
+    assert netsim.run(main(), seed=1)
+
+
+def test_genuine_servfail_still_propagates():
+    async def main():
+        wire = netsim.SimWire(_zone(),
+                              behaviors={'9.9.9.1': 'servfail'})
+        client = DnsClient(transport=wire)
+        err, _msg = await _lookup(client, 'a.sim', 'A', ['9.9.9.1'])
+        assert isinstance(err, DnsError) and err.code == 'SERVFAIL'
+        return True
+
+    assert netsim.run(main(), seed=1)
+
+
+def test_tc_bit_retries_over_tcp_and_uses_full_answer():
+    async def main():
+        wire = netsim.SimWire(_zone(), behaviors={'9.9.9.2': 'tc-udp'})
+        client = DnsClient(transport=wire)
+        err, msg = await _lookup(client, 'big.sim', 'A', ['9.9.9.2'])
+        assert err is None
+        assert msg.get_answers()[0]['target'] == '10.0.0.7'
+        assert [e[0] for e in wire.log] == ['udp', 'tcp']
+        return True
+
+    assert netsim.run(main(), seed=1)
+
+
+def test_truncated_packet_surfaces_as_parse_error_not_crash():
+    async def main():
+        wire = netsim.SimWire(_zone(),
+                              behaviors={'9.9.9.3': 'truncate'})
+        client = DnsClient(transport=wire)
+        err, _msg = await _lookup(client, 'a.sim', 'A', ['9.9.9.3'])
+        assert isinstance(err, ValueError)
+        assert 'malformed DNS response' in str(err)
+        return True
+
+    assert netsim.run(main(), seed=1)
+
+
+def test_blackholed_resolver_times_out_and_next_wave_answers():
+    async def main():
+        wire = netsim.SimWire(_zone(), behaviors={
+            '9.9.9.4': 'blackhole', '9.9.9.5': 'blackhole',
+            '9.9.9.6': 'blackhole'})
+        # concurrency 3: the whole first wave blackholes, the second
+        # wave's healthy resolver answers within the overall budget.
+        client = DnsClient(concurrency=3, transport=wire)
+        err, msg = await _lookup(
+            client, 'a.sim', 'A',
+            ['9.9.9.4', '9.9.9.5', '9.9.9.6', '9.9.9.9'],
+            timeout=2000)
+        assert err is None
+        assert msg.get_answers()[0]['target'] == '1.2.3.4'
+        return True
+
+    assert netsim.run(main(), seed=1)
+
+
+def test_all_resolvers_blackholed_yields_multierror_of_timeouts():
+    async def main():
+        wire = netsim.SimWire(_zone(), behaviors={
+            '9.9.9.4': 'blackhole', '9.9.9.5': 'blackhole'})
+        client = DnsClient(transport=wire)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        err, _msg = await _lookup(client, 'a.sim', 'A',
+                                  ['9.9.9.4', '9.9.9.5'],
+                                  timeout=1000)
+        elapsed = loop.time() - t0
+        assert isinstance(err, MultiError)
+        assert all(isinstance(e, DnsTimeoutError)
+                   for e in err.errors())
+        # The per-resolver budget is shared, not stacked: both
+        # timeouts fit inside roughly one overall timeout.
+        assert elapsed < 1.5
+        return True
+
+    assert netsim.run(main(), seed=1)
+
+
+def test_shared_deadline_spans_fallback_retries():
+    """The EDNS fallback consumes what REMAINS of the resolver's
+    deadline, not a fresh slice: a middlebox that FORMERRs the EDNS
+    query and then blackholes the retry must still conclude within
+    one budget."""
+
+    class FormerrThenBlackhole(netsim.SimWire):
+        async def _common(self, proto, resolver, payload, timeout_s):
+            qid, domain, qtype, has_opt = netsim.parse_query(payload)
+            if has_opt:
+                await asyncio.sleep(self.latency_s)
+                return netsim.encode_response(qid, domain, qtype,
+                                              rcode='FORMERR')
+            await asyncio.sleep(timeout_s)
+            raise asyncio.TimeoutError()
+
+    async def main():
+        wire = FormerrThenBlackhole(_zone())
+        client = DnsClient(transport=wire)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        err, _msg = await _lookup(client, 'a.sim', 'A', ['9.9.9.1'],
+                                  timeout=1000)
+        elapsed = loop.time() - t0
+        assert isinstance(err, DnsTimeoutError)
+        assert elapsed == pytest.approx(1.0, abs=0.1)
+        return True
+
+    assert netsim.run(main(), seed=1)

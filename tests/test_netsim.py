@@ -1,0 +1,304 @@
+"""Unit tests for the netsim primitives themselves: virtual clock and
+loop semantics, seam install/restore, fabric link models and fault
+schedules, zone/wire codec round-trips, and herd statistics. The
+scenario corpus (tests/scenarios/) builds on these; this file pins the
+primitives' contracts."""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu import netsim, utils
+
+
+# -- virtual clock / loop -------------------------------------------------
+
+def test_virtual_time_advances_only_through_timers():
+    async def main():
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await asyncio.sleep(123.456)
+        return loop.time() - t0
+
+    assert netsim.run(main(), seed=1) == pytest.approx(123.456)
+
+
+def test_timers_fire_in_deadline_order():
+    async def main():
+        loop = asyncio.get_running_loop()
+        order = []
+        loop.call_later(3.0, order.append, 'c')
+        loop.call_later(1.0, order.append, 'a')
+        loop.call_later(2.0, order.append, 'b')
+        await asyncio.sleep(5.0)
+        return order
+
+    assert netsim.run(main(), seed=1) == ['a', 'b', 'c']
+
+
+def test_wait_for_times_out_on_virtual_time():
+    async def main():
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(asyncio.Event().wait(), timeout=7.0)
+        return asyncio.get_running_loop().time()
+
+    assert netsim.run(main(), seed=1) == pytest.approx(7.0)
+
+
+def test_starved_loop_raises_instead_of_hanging():
+    async def main():
+        await asyncio.Event().wait()   # nothing will ever set it
+
+    with pytest.raises(netsim.LoopStarvedError):
+        netsim.run(main(), seed=1)
+
+
+def test_run_installs_and_restores_clock_and_rng_seams():
+    before_clock = utils.get_clock()
+    before_rng = utils.get_rng()
+
+    async def main():
+        assert isinstance(utils.get_clock(), netsim.VirtualClock)
+        assert utils.get_rng() is not before_rng
+        # wall time is anchored at the fixed virtual epoch
+        assert utils.wall_time() >= netsim.VIRTUAL_EPOCH
+        return utils.current_millis()
+
+    netsim.run(main(), seed=5)
+    assert utils.get_clock() is before_clock
+    assert utils.get_rng() is before_rng
+
+
+def test_seed_pins_the_rng_stream():
+    async def main():
+        return [utils.get_rng().random() for _ in range(4)]
+
+    assert netsim.run(main(), seed=9) == netsim.run(main(), seed=9)
+    assert netsim.run(main(), seed=9) != netsim.run(main(), seed=10)
+
+
+# -- fabric ---------------------------------------------------------------
+
+def _backend(key, addr='10.0.0.1', port=80):
+    return {'key': key, 'name': key, 'address': addr, 'port': port}
+
+
+def _collect(conn):
+    seen = []
+    for ev in ('connect', 'error', 'close'):
+        conn.on(ev, lambda e=None, ev=ev: seen.append(ev))
+    return seen
+
+
+def test_fabric_connect_completes_after_link_latency():
+    async def main():
+        fabric = netsim.Fabric()
+        fabric.set_link('b1', latency_ms=250.0)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        conn = fabric.constructor(_backend('b1'))
+        seen = _collect(conn)
+        await asyncio.sleep(1.0)
+        return seen, loop.time() - t0, conn.connected
+
+    seen, _elapsed, connected = netsim.run(main(), seed=2)
+    assert seen == ['connect'] and connected
+
+
+def test_fabric_rst_and_hang_and_loss_modes():
+    async def main():
+        fabric = netsim.Fabric()
+        fabric.set_link('rst', connect='rst')
+        fabric.set_link('hang', connect='hang')
+        fabric.set_link('lossy', loss=1.0)
+        out = {}
+        for key in ('rst', 'hang', 'lossy'):
+            conn = fabric.constructor(_backend(key))
+            out[key] = _collect(conn)
+        await asyncio.sleep(5.0)
+        return out
+
+    out = netsim.run(main(), seed=2)
+    assert out['rst'] == ['error']
+    assert out['hang'] == []          # pool's connect timeout decides
+    assert out['lossy'] == ['error']
+
+
+def test_partition_kills_established_and_hangs_new_connects():
+    async def main():
+        fabric = netsim.Fabric()
+        conn = fabric.constructor(_backend('b1'))
+        seen = _collect(conn)
+        await asyncio.sleep(0.1)
+        assert conn.connected
+        fabric.partition(['b1'])
+        late = fabric.constructor(_backend('b1'))
+        late_seen = _collect(late)
+        await asyncio.sleep(5.0)
+        fabric.heal()
+        healed = fabric.constructor(_backend('b1'))
+        healed_seen = _collect(healed)
+        await asyncio.sleep(1.0)
+        return seen, late_seen, healed_seen
+
+    seen, late_seen, healed_seen = netsim.run(main(), seed=2)
+    assert seen == ['connect', 'error']
+    assert late_seen == []
+    assert healed_seen == ['connect']
+
+
+def test_asymmetric_partition_spares_established_flows():
+    async def main():
+        fabric = netsim.Fabric()
+        conn = fabric.constructor(_backend('b1'))
+        seen = _collect(conn)
+        await asyncio.sleep(0.1)
+        fabric.partition(['b1'], kill_established=False)
+        late = fabric.constructor(_backend('b1'))
+        late_seen = _collect(late)
+        await asyncio.sleep(5.0)
+        return seen, late_seen
+
+    seen, late_seen = netsim.run(main(), seed=2)
+    assert seen == ['connect']        # survived the partition
+    assert late_seen == []            # new handshake blackholed
+
+
+def test_gray_failure_stretches_service_times():
+    async def main():
+        fabric = netsim.Fabric()
+        for i in range(10):
+            fabric.set_link('b%d' % i, service_ms=2.0)
+        gray = fabric.set_gray(0.2, mult=100.0)
+        assert len(gray) == 2
+        fast = fabric.constructor(
+            _backend(next(k for k in sorted(fabric._links)
+                          if k not in gray)))
+        slow = fabric.constructor(_backend(gray[0]))
+        await asyncio.sleep(0.1)
+        return fast.service_time_s(), slow.service_time_s()
+
+    fast_t, slow_t = netsim.run(main(), seed=4)
+    assert slow_t == pytest.approx(fast_t * 100.0)
+
+
+def test_manual_connection_is_test_driven():
+    async def main():
+        fabric = netsim.Fabric()
+        conn = netsim.ManualConnection(fabric, _backend('b1'))
+        seen = _collect(conn)
+        await asyncio.sleep(1.0)
+        assert seen == []             # nothing until the test says so
+        conn.connect()
+        return seen, conn.connected
+
+    seen, connected = netsim.run(main(), seed=2)
+    assert seen == ['connect'] and connected
+
+
+# -- zone / wire codec ----------------------------------------------------
+
+def test_zone_nxdomain_vs_nodata_vs_answers():
+    zone = netsim.SimZone(soa_minimum=17)
+    zone.add('a.sim', 'A', '1.2.3.4', ttl=30)
+    assert zone.resolve('nope.sim', 'A')[0] == 'NXDOMAIN'
+    rcode, answers, _ = zone.resolve('a.sim', 'A')
+    assert rcode == 'NOERROR' and answers[0]['target'] == '1.2.3.4'
+    rcode, answers, authority = zone.resolve('a.sim', 'AAAA')
+    assert rcode == 'NOERROR' and not answers
+    assert authority[0]['type'] == 'SOA'
+    assert authority[0]['minimum'] == 17
+    zone.remove('a.sim')              # NODATA: name still known
+    assert zone.resolve('a.sim', 'A')[1] == []
+    assert zone.resolve('a.sim', 'A')[0] == 'NOERROR'
+    zone.forget('a.sim')              # now NXDOMAIN
+    assert zone.resolve('a.sim', 'A')[0] == 'NXDOMAIN'
+
+
+def test_wire_codec_round_trips_through_real_parser():
+    from cueball_tpu.dns_client import build_query, parse_response
+    payload = build_query(77, 'svc.sim', 'SRV')
+    qid, domain, qtype, has_opt = netsim.parse_query(payload)
+    assert (qid, domain, qtype, has_opt) == (77, 'svc.sim', 'SRV',
+                                             True)
+    data = netsim.encode_response(
+        77, 'svc.sim', 'SRV',
+        answers=[{'name': 'svc.sim', 'type': 'SRV', 'ttl': 60,
+                  'target': 'b1.sim', 'port': 8080, 'priority': 1,
+                  'weight': 5}],
+        additionals=[{'name': 'b1.sim', 'type': 'AAAA', 'ttl': 60,
+                      'target': 'fd00::7'}])
+    msg = parse_response(data)
+    assert msg.qid == 77 and msg.rcode == 'NOERROR' and not msg.tc
+    srv = msg.get_answers()[0]
+    assert (srv['target'], srv['port'], srv['priority']) == \
+        ('b1.sim', 8080, 1)
+    assert msg.get_additionals()[0]['target'] == 'fd00::7'
+
+
+# -- scenario harness ------------------------------------------------------
+
+def test_scenario_schedule_fires_at_virtual_times():
+    sc = netsim.Scenario('sched-check', seed=11)
+    hits = []
+    sc.at(2.0, 'two', lambda: hits.append('two'))
+    sc.at(1.0, 'one', lambda: hits.append('one'))
+
+    async def main():
+        await asyncio.sleep(3.0)
+        return list(hits)
+
+    assert sc.run(lambda: main()) == ['one', 'two']
+    assert [label for _t, label in sc.fired] == ['one', 'two']
+    assert sc.fired[0][0] == pytest.approx(1.0)
+
+
+def test_scenario_failure_dump_and_replay_hint(tmp_path, monkeypatch):
+    monkeypatch.setenv(netsim.scenario.DUMP_DIR_ENV, str(tmp_path))
+    sc = netsim.Scenario('doomed', seed=13)
+    sc.at(1.0, 'boom', lambda: None)
+
+    async def main():
+        await asyncio.sleep(2.0)
+        raise AssertionError('envelope blown')
+
+    with pytest.raises(AssertionError):
+        sc.run(lambda: main())
+    import json
+    dump = json.loads((tmp_path / 'doomed-seed13.json').read_text())
+    assert dump['seed'] == 13 and dump['scenario'] == 'doomed'
+    assert dump['schedule'] == [[1.0, 'boom']]
+    assert 'pytest' in dump['replay']
+
+
+def test_herd_statistics_helpers():
+    outcomes = [
+        {'cohort': 'a', 'ok': True}, {'cohort': 'a', 'ok': True},
+        {'cohort': 'b', 'ok': True}, {'cohort': 'b', 'ok': False},
+    ]
+    rates = netsim.success_rates(outcomes)
+    assert rates == {'a': 1.0, 'b': 0.5}
+    assert netsim.jain_index([1.0, 1.0, 1.0]) == pytest.approx(1.0)
+    assert netsim.jain_index([1.0, 0.0]) == pytest.approx(0.5)
+    assert netsim.quantile([5, 1, 9, 3], 0.0) == 1
+    assert netsim.quantile([5, 1, 9, 3], 1.0) == 9
+
+
+def test_run_metadata_lands_in_trace_and_monitor_surfaces():
+    from cueball_tpu import trace as mod_trace
+    from cueball_tpu.monitor import pool_monitor
+    sc = netsim.Scenario('meta-check', seed=21)
+    captured = {}
+
+    async def main():
+        captured['summary'] = mod_trace.summary()
+        captured['snapshot'] = pool_monitor.snapshot()
+        await asyncio.sleep(0.01)
+
+    sc.run(lambda: main())
+    assert captured['summary']['run']['scenario'] == 'meta-check'
+    assert captured['summary']['run']['seed'] == 21
+    assert captured['snapshot']['netsim_run']['scenario'] == \
+        'meta-check'
+    # restored after the run
+    assert 'run' not in mod_trace.summary()

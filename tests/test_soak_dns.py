@@ -9,15 +9,20 @@ randomized record sets and 1s TTLs, NXDOMAIN, NODATA, NOTIMP,
 REFUSED, SERVFAIL, timeouts) is drawn from a seeded rng, across many
 TTL-driven re-query cycles. Invariants: the emitted added/removed
 stream stays consistent with list(), the resolver never wedges
-outside its documented states, and it always stops cleanly."""
+outside its documented states, and it always stops cleanly.
+
+The chaos nameserver is netsim's ChaosDnsClient primitive
+(cueball_tpu/netsim/dns.py) — the same band table the inline fake
+here used to implement — run two ways to prove parity: on the real
+loop over wall time (as this soak always ran), and under the netsim
+virtual loop where the identical soak costs milliseconds."""
 
 import asyncio
 import random
 
 import pytest
 
-from cueball_tpu.dns_client import (DnsError, DnsMessage,
-                                    DnsTimeoutError)
+from cueball_tpu import netsim
 from cueball_tpu.dns_resolver import DNSResolver
 
 from conftest import run_async, wait_for_state
@@ -27,62 +32,9 @@ RECOVERY = {'default': {'timeout': 40, 'retries': 2, 'delay': 5,
                         'maxDelay': 20}}
 
 
-def _rr(name, rtype, ttl, target, port=None):
-    return {'name': name, 'type': rtype, 'ttl': ttl, 'target': target,
-            'port': port}
-
-
-class ChaosDnsClient:
-    """Per-query outcome drawn from a seeded rng. Answers use 1-second
-    TTLs so the resolver's sleep state re-queries continuously."""
-
-    def __init__(self, rng):
-        self.rng = rng
-        self.queries = 0
-
-    def lookup(self, opts, cb):
-        loop = asyncio.get_running_loop()
-        self.queries += 1
-        domain, qtype = opts['domain'], opts['type']
-        roll = self.rng.random()
-
-        if roll < 0.50:
-            answers = []
-            if qtype == 'SRV':
-                for i in range(self.rng.randint(1, 3)):
-                    answers.append(_rr(domain, 'SRV', 1,
-                                       't%d.chaos' % i, 100 + i))
-            elif qtype == 'A':
-                for i in range(self.rng.randint(1, 2)):
-                    answers.append(_rr(domain, 'A', 1,
-                                       '10.0.0.%d' % (1 + i)))
-            elif qtype == 'AAAA' and self.rng.random() < 0.5:
-                answers.append(_rr(domain, 'AAAA', 1, 'fd00::1'))
-            msg = DnsMessage(1, 'NOERROR', False, answers, [], [])
-            loop.call_soon(cb, None, msg)
-        elif roll < 0.62:
-            loop.call_soon(cb, DnsError('NXDOMAIN', domain), None)
-        elif roll < 0.72:
-            # NODATA: NOERROR with empty answers (+ sometimes SOA ttl)
-            authority = []
-            if self.rng.random() < 0.5:
-                authority.append(_rr(domain, 'SOA', 1, None))
-            msg = DnsMessage(1, 'NOERROR', False, [], authority, [])
-            loop.call_soon(cb, None, msg)
-        elif roll < 0.79:
-            loop.call_soon(cb, DnsError('NOTIMP', domain), None)
-        elif roll < 0.86:
-            loop.call_soon(cb, DnsError('REFUSED', domain), None)
-        elif roll < 0.93:
-            loop.call_soon(cb, DnsError('SERVFAIL', domain), None)
-        else:
-            loop.call_later(opts['timeout'] / 1000.0, cb,
-                            DnsTimeoutError(domain), None)
-
-
 async def _soak(seed, run_s=3.0):
     rng = random.Random(seed)
-    client = ChaosDnsClient(rng)
+    client = netsim.ChaosDnsClient(rng)
     res = DNSResolver({
         'domain': 'svc.chaos',
         'service': '_chaos._tcp',
@@ -114,8 +66,20 @@ async def _soak(seed, run_s=3.0):
     assert set(backends) == set(res.list()), (
         'event stream diverged: %r vs %r' % (
             sorted(backends), sorted(res.list())))
+    return client.queries
 
 
 @pytest.mark.parametrize('seed', [3, 91, 5077])
 def test_soak_dns_random_chaos(seed):
     run_async(_soak(seed), timeout=30)
+
+
+@pytest.mark.parametrize('seed', [3, 91, 5077])
+def test_soak_dns_random_chaos_virtual(seed):
+    """The identical soak under the netsim virtual loop: a much longer
+    virtual window (30s vs 3s) still finishes in wall milliseconds,
+    and the same invariants hold — the netsim primitives are a
+    superset of what the wall-clock fake proved."""
+    queries = netsim.run(_soak(seed, run_s=30.0), seed=seed)
+    assert queries >= 20, \
+        'virtual window saw only %d queries' % queries
