@@ -329,10 +329,26 @@ async def bench_tracing_ab(ops=TRACING_AB_OPS_PER_TRIAL,
         out[arm + '_trials'] = [round(r, 1) for r in xs]
     off = statistics.mean(arms['off_pre'] + arms['off_post'])
     on = statistics.mean(arms['on'])
-    out['tracing_on_overhead_pct'] = round(100.0 * (off - on) / off, 2)
+    out['tracing_on_overhead_pct_mean'] = round(
+        100.0 * (off - on) / off, 2)
+    # Headline figure: pair each round's on arm against that SAME
+    # round's two off arms (cancelling slow host drift, which the
+    # interleaving spreads across arms but the all-rounds mean does
+    # not), then take the median across rounds so one preempted round
+    # cannot swing the guard (r7: round-level overhead spread on a
+    # noisy host was 3%..15% around a ~3% median).
+    per_round = []
+    for i in range(len(arms['on'])):
+        off_i = (arms['off_pre'][i] + arms['off_post'][i]) / 2.0
+        per_round.append(100.0 * (off_i - arms['on'][i]) / off_i)
+    out['tracing_on_overhead_pct_rounds'] = [
+        round(x, 2) for x in per_round]
+    out['tracing_on_overhead_pct'] = round(
+        statistics.median(per_round), 2)
     out['protocol'] = ('%d rounds x %d ops x 3 interleaved arms '
                        '(off-pre / on / off-post), 1 warmup round, '
-                       'gc frozen+disabled in timed sections') % (
+                       'gc frozen+disabled in timed sections; overhead '
+                       'pct is the median of per-round paired deltas') % (
         trials, ops)
     return out
 
@@ -761,7 +777,51 @@ def _telemetry_child_main(progress_path: str) -> None:
     print(json.dumps(acc))
 
 
-def bench_telemetry_step_guarded(timeout_s: float = 300.0) -> dict:
+def chip_probe(timeout_s: float = 45.0) -> dict:
+    """Cheap accelerator probe for the start of a bench round.
+
+    Answers in seconds whether a chip capture is even worth
+    attempting, and its outcome is recorded in the round JSON
+    (assemble_result) so a round full of null chip fields carries its
+    own explanation instead of emitting them silently (every chip
+    field in BENCH_r06.json was null with nothing saying why).
+
+    Outcomes: 'accelerator' (a real chip answered — run the capture),
+    'cpu-pinned-env' (JAX_PLATFORMS pins cpu; CI exercising the staged
+    path — the stage still runs, on the host backend), 'cpu-only' (jax
+    came up but only with the host backend), 'timeout' (tunnel not
+    answering), 'failed' (probe subprocess errored)."""
+    import subprocess
+    import sys
+    if 'cpu' in (os.environ.get('JAX_PLATFORMS') or ''):
+        return {'outcome': 'cpu-pinned-env', 'backend': 'cpu',
+                'detail': 'JAX_PLATFORMS pins cpu; probe skipped'}
+    probe = 'import jax; print(jax.default_backend())'
+    try:
+        pr = subprocess.run([sys.executable, '-c', probe],
+                            capture_output=True, text=True,
+                            timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {'outcome': 'timeout', 'backend': None,
+                'detail': 'backend probe timed out after %gs '
+                          '(chip tunnel not answering)' % timeout_s}
+    if pr.returncode != 0:
+        return {'outcome': 'failed', 'backend': None,
+                'detail': 'backend probe failed: %s' % (
+                    pr.stderr.strip().splitlines()[-1]
+                    if pr.stderr.strip()
+                    else 'exit %d' % pr.returncode)}
+    backend = pr.stdout.strip()
+    if backend == 'cpu':
+        return {'outcome': 'cpu-only', 'backend': 'cpu',
+                'detail': 'backend probe answered "cpu"; '
+                          'no chip attached'}
+    return {'outcome': 'accelerator', 'backend': backend,
+            'detail': 'backend probe answered %r' % backend}
+
+
+def bench_telemetry_step_guarded(timeout_s: float = 300.0,
+                                 probe: dict | None = None) -> dict:
     """The staged chip benchmark in a KILLABLE subprocess.
 
     Two reasons it must be a subprocess, not a thread: TPU backend
@@ -787,34 +847,16 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0) -> dict:
     import sys
     import tempfile
     root = os.path.dirname(os.path.abspath(__file__))
-    if 'cpu' not in (os.environ.get('JAX_PLATFORMS') or ''):
-        probe_timeout_s = 45.0
-        probe = ('import jax; print(jax.default_backend())')
-        try:
-            pr = subprocess.run([sys.executable, '-c', probe],
-                                capture_output=True, text=True,
-                                timeout=probe_timeout_s)
-        except subprocess.TimeoutExpired:
-            err = ('no accelerator: backend probe timed out after %gs '
-                   '(chip tunnel not answering); skipping the chip '
-                   'stage' % probe_timeout_s)
-            print('bench: %s' % err, file=sys.stderr)
-            return {'stages_completed': [], 'error': err}
-        if pr.returncode != 0:
-            err = 'no accelerator: backend probe failed: %s' % (
-                pr.stderr.strip().splitlines()[-1]
-                if pr.stderr.strip() else 'exit %d' % pr.returncode)
-            print('bench: %s' % err, file=sys.stderr)
-            return {'stages_completed': [], 'error': err}
-        if pr.stdout.strip() == 'cpu':
-            # jax came up but only with the host backend: there is no
-            # chip here, and minutes of CPU-run stages would wear a
-            # chip stage's labels. The committed artifact citation
-            # covers the JSON instead (assemble_result).
-            err = ('no accelerator: backend probe answered "cpu"; '
-                   'skipping the chip stage')
-            print('bench: %s' % err, file=sys.stderr)
-            return {'stages_completed': [], 'error': err}
+    if probe is None:
+        probe = chip_probe()
+    if probe['outcome'] in ('timeout', 'failed', 'cpu-only'):
+        # No chip: minutes of CPU-run stages would wear a chip stage's
+        # labels. The committed artifact citation covers the JSON
+        # instead (assemble_result).
+        err = 'no accelerator: %s; skipping the chip stage' % (
+            probe['detail'])
+        print('bench: %s' % err, file=sys.stderr)
+        return {'stages_completed': [], 'error': err}
     fd, progress = tempfile.mkstemp(prefix='bench_telem_',
                                     suffix='.jsonl')
     os.close(fd)
@@ -909,7 +951,8 @@ def artifact_citation(root: str | None = None) -> dict:
 
 
 def assemble_result(abs_err, claim, queued, host_tick, telem,
-                    tracing_ab=None, pump_ab=None) -> dict:
+                    tracing_ab=None, pump_ab=None,
+                    probe=None) -> dict:
     """Build the single JSON-line result from the stage outputs.
 
     Factored out of main() so the guard tests can assert the
@@ -985,6 +1028,10 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
         result['claim_tracing_ab'] = tracing_ab
     if pump_ab is not None:
         result['claim_pump_ab'] = pump_ab
+    if probe is not None:
+        # Why the chip fields are (or aren't) null, in the round
+        # record itself.
+        result['chip_probe'] = probe
     if telem.get('error') is not None:
         result['telemetry_error'] = telem['error']
     if telem.get('pools_per_sec_live') is None:
@@ -1018,16 +1065,25 @@ async def main(host_only: bool = False):
     except (AttributeError, OSError):
         pass
 
+    # Probe the chip FIRST and carry the outcome into the round
+    # record: --host-only rounds used to emit every chip field as a
+    # bare null with nothing saying whether a capture was even
+    # attempted. (The probe is its own short-lived subprocess, so the
+    # CPU pinning above is unaffected.)
+    probe = chip_probe()
+
     abs_err = await bench_codel_tracking()
     claim = await bench_claim_throughput()
     queued = await bench_queued_claim_throughput()
     tracing_ab = await bench_tracing_ab()
     pump_ab = await bench_pump_ab()
     host_tick = bench_sampler_tick_host()
-    telem = {} if host_only else bench_telemetry_step_guarded()
+    telem = {} if host_only else bench_telemetry_step_guarded(
+        probe=probe)
 
     result = assemble_result(abs_err, claim, queued, host_tick, telem,
-                             tracing_ab=tracing_ab, pump_ab=pump_ab)
+                             tracing_ab=tracing_ab, pump_ab=pump_ab,
+                             probe=probe)
     if host_only:
         result['host_only'] = True
     print(json.dumps(result))

@@ -33,11 +33,19 @@ import logging
 import math
 
 from . import errors as mod_errors
+from . import runq as mod_runq
 from . import trace as mod_trace
 from . import utils as mod_utils
 from .events import _native
 from .fsm import FSM
 from .runq import defer
+
+# Terminal claim handles are recycled through a C freelist when the
+# native engine is loaded (see obtain_claim_handle): allocating the
+# handle + its dict + FSM innards is a measurable slice of the queued
+# claim path (docs/claim-path-profile.md round 6).
+_HANDLE_FREELIST = _native is not None and \
+    hasattr(_native, 'handle_free_pop')
 
 # FSM state-handle gates are framework-internal listeners; the native
 # Gate type carries no attributes, so recognize it by type.
@@ -508,11 +516,22 @@ class CueBallClaimHandle(FSM):
     def arm_claim_timer(self) -> None:
         """Called by the pool when this handle parks in the claim
         queue: arm the claim timeout now (see state_waiting — claims
-        served without parking never pay for a timer)."""
+        served without parking never pay for a timer). After arming,
+        _ch_arm_timer holds the wheel token instead of the closure."""
         arm = self._ch_arm_timer
-        if arm is not None:
-            self._ch_arm_timer = None
+        if callable(arm):
             arm()
+
+    def _ch_wheel_fire(self, token) -> None:
+        """Deadline bucket fired (runq timer wheel). The wheel rounds
+        deadlines UP to the next quantum, so firing is never early;
+        stale tokens (this handle re-parked or resolved since) are
+        recognized by identity and ignored."""
+        if self._ch_arm_timer is not token:
+            return
+        self._ch_arm_timer = None
+        if self.is_in_state('waiting'):
+            self.timeout()
 
     def _ch_unpark(self) -> None:
         """O(1)-unlink this handle's claim-queue node, if parked. Runs
@@ -521,10 +540,13 @@ class CueBallClaimHandle(FSM):
         dequeue that may not come (the pool used to do this from a
         per-claim stateChanged listener; owning it here saves that
         subscription on the claim hot path). Also drops the un-fired
-        arm closure: it captures the waiting state's handle, and a
-        fast-path claim would otherwise pin that for the whole
-        lease."""
+        arm closure (it captures the waiting state's handle, and a
+        fast-path claim would otherwise pin that for the whole lease)
+        or cancels the armed wheel token."""
+        tok = self._ch_arm_timer
         self._ch_arm_timer = None
+        if type(tok) is tuple:
+            mod_runq.wheel_cancel(tok)
         node = self.ch_waiter_node
         if node is not None:
             node.remove()
@@ -638,17 +660,23 @@ class CueBallClaimHandle(FSM):
         # The timeout timer is armed LAZILY, by the pool, only when
         # the handle actually parks in the wait queue
         # (arm_claim_timer): a claim served from the idle queue never
-        # waits, and skipping the arm+cancel saves a TimerHandle
-        # alloc + timer-heap churn on every fast-path claim. The
+        # waits, and skipping the arm+cancel saves timer churn on
+        # every fast-path claim. Armed deadlines go to the runq timer
+        # wheel — one shared loop.call_later per 5ms bucket instead of
+        # a TimerHandle + timer-heap entry per parked claim; the
         # deadline stays measured from ch_started, so the deferred
-        # arm never extends it.
-        def _arm():
-            if isinstance(self.ch_claim_timeout, (int, float)) and \
-                    math.isfinite(self.ch_claim_timeout):
-                remaining = self.ch_claim_timeout - (
-                    mod_utils.current_millis() - self.ch_started)
-                S.timeout(max(remaining, 0.0), on_timeout)
-        self._ch_arm_timer = _arm
+        # arm never extends it, and the wheel's fire calls
+        # _ch_wheel_fire -> timeout(), handled by on_timeout above.
+        t = self.ch_claim_timeout
+        if isinstance(t, (int, float)) and math.isfinite(t):
+            def _arm():
+                self._ch_arm_timer = mod_runq.wheel_arm(
+                    self.ch_started + t, self)
+            self._ch_arm_timer = _arm
+        else:
+            # No finite deadline: nothing to arm, so don't make the
+            # pool's arm_claim_timer pay for a closure per park.
+            self._ch_arm_timer = None
 
         S.on(self, 'timeout', on_timeout)
 
@@ -730,6 +758,10 @@ class CueBallClaimHandle(FSM):
         S.validTransitions([])
         if self.ch_trace is not None:
             self.ch_trace.released('release')
+        if _HANDLE_FREELIST:
+            # After this tick's pump batch (deferred stateChanged
+            # emissions included) the handle is inert; recycle it.
+            defer(self._ch_recycle)
         if not self.ch_do_release_leak_check:
             return
         conn = self.ch_connection
@@ -762,6 +794,8 @@ class CueBallClaimHandle(FSM):
         # No leak check: the connection is being closed anyway.
         if self.ch_trace is not None:
             self.ch_trace.released('close')
+        if _HANDLE_FREELIST:
+            defer(self._ch_recycle)
 
     def state_cancelled(self, S):
         S.validTransitions([])
@@ -777,6 +811,41 @@ class CueBallClaimHandle(FSM):
         if self.ch_trace is not None:
             self.ch_trace.failed(self.ch_last_error)
         S.immediate(lambda: self.ch_callback(self.ch_last_error))
+
+    def _ch_recycle(self) -> None:
+        """Deferred from the terminal released/closed entries: clear
+        every internal reference that could pin pool state (crucially
+        ch_requeue — its try_next closure cycles back through the
+        pool) and offer the handle to the C freelist. NOT run from
+        failed/cancelled: state_failed's deferred callback still needs
+        ch_callback, and neither state is worth optimizing."""
+        if type(self) is not CueBallClaimHandle:
+            return  # subclasses must not resurface as plain handles
+        if not (self.is_in_state('released') or
+                self.is_in_state('closed')):
+            return
+        self.ch_requeue = None
+        self.ch_callback = None
+        self.ch_slot = None
+        self.ch_connection = None
+        self.ch_waiter_node = None
+        self.ch_trace = None
+        self.ch_pre_listeners = {}
+        _native.handle_free_push(self)
+
+
+def obtain_claim_handle(options: dict) -> CueBallClaimHandle:
+    """Claim-handle factory for the pool hot path: recycle a terminal
+    handle from the C freelist when the native engine is loaded
+    (re-running __init__ re-enters 'waiting' exactly like a fresh
+    construction), else construct one."""
+    if _HANDLE_FREELIST:
+        h = _native.handle_free_pop()
+        if h is not None:
+            h.remove_all_listeners()
+            h.__init__(options)
+            return h
+    return CueBallClaimHandle(options)
 
 
 # ---------------------------------------------------------------------------

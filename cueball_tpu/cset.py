@@ -25,11 +25,10 @@ from __future__ import annotations
 
 import logging
 import math
-import uuid as mod_uuid
 
 from . import trace as mod_trace
 from . import utils as mod_utils
-from .connection_fsm import ConnectionSlotFSM, CueBallClaimHandle
+from .connection_fsm import ConnectionSlotFSM, obtain_claim_handle
 from .events import EventEmitter
 from .fsm import FSM
 from .pool import _Interval
@@ -46,7 +45,7 @@ class ConnectionSet(FSM):
         if not callable(constructor):
             raise AssertionError('options.constructor must be callable')
 
-        self.cs_uuid = str(mod_uuid.uuid4())
+        self.cs_uuid = mod_utils.make_uuid()
         self.cs_constructor = constructor
 
         if options.get('resolver') is None:
@@ -537,7 +536,7 @@ class LogicalConnection(FSM):
             self.lc_conn = conn
             S.gotoState('advertised')
 
-        self.lc_hdl = CueBallClaimHandle({
+        self.lc_hdl = obtain_claim_handle({
             'pool': self.lc_set,
             'claimStack': ('Error\n'
                            ' at claim\n'

@@ -35,7 +35,6 @@ import logging
 import math
 import os
 import socket
-import uuid as mod_uuid
 
 from . import dns_client as mod_nsc
 from . import trace as mod_trace
@@ -117,7 +116,7 @@ class DNSResolverFSM(FSM):
         if not isinstance(domain, str):
             raise AssertionError('options.domain must be a string')
 
-        self.r_uuid = str(mod_uuid.uuid4())
+        self.r_uuid = mod_utils.make_uuid()
         self.r_resolvers = list(resolvers or [])
         self.r_domain = domain
         self.r_service = options.get('service') or '_http._tcp'

@@ -110,6 +110,24 @@ class Scenario:
             'replay': 'python -m pytest "tests/scenarios" -k '
                       '"%s and %d" -q' % (self.name, self.seed),
         })
+        if mod_trace._runtime is not None:
+            # Tracing was on for this run: embed the slowest completed
+            # claim/DNS traces (full span lists, the NDJSON records
+            # parsed back) so the dump shows WHERE the slow claims
+            # spent their time, not just that the envelope broke.
+            # trace_ring() drains the native ring first, so this works
+            # identically under either recorder.
+            try:
+                done = [t for t in mod_trace.trace_ring()
+                        if t.root.end is not None]
+                done.sort(key=lambda t: t.root.end - t.root.start,
+                          reverse=True)
+                record['trace_summary'] = mod_trace.summary()
+                record['slowest_traces'] = [
+                    [json.loads(line) for line in t.ndjson_lines()]
+                    for t in done[:3]]
+            except Exception:
+                pass  # the dump must never mask the original error
         try:
             os.makedirs(dump_dir, exist_ok=True)
             with open(path, 'w') as f:

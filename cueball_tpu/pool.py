@@ -27,13 +27,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
-import uuid as mod_uuid
 
 from . import codel as mod_codel
 from . import errors as mod_errors
 from . import trace as mod_trace
 from . import utils as mod_utils
-from .connection_fsm import ConnectionSlotFSM, CueBallClaimHandle
+from .connection_fsm import ConnectionSlotFSM, obtain_claim_handle
 from .cqueue import Queue
 from .events import EventEmitter
 from .fsm import FSM, get_loop
@@ -70,22 +69,30 @@ LP_TAPS = gen_taps(128, -0.2)
 class FIRFilter:
     """FIR filter over a circular buffer (reference lib/pool.js:78-100).
 
-    The pure-Python form is the pool's hot-path implementation (one
-    128-tap dot product per 200ms); `cueball_tpu.ops.fir` holds the
-    batched JAX/TPU form used for fleet-wide telemetry."""
+    The pure-Python form is the pool's hot-path implementation;
+    `cueball_tpu.ops.fir` holds the batched JAX/TPU form used for
+    fleet-wide telemetry. Samples arrive at LP_RATE (5 Hz) but the
+    output is read on every rebalance pass — potentially thousands of
+    times per sample under queued load — so the dot product is
+    evaluated lazily once per put() and cached between samples."""
 
     def __init__(self, taps: list[float]):
         self.f_taps = taps
         self.f_buf = [0.0] * len(taps)
         self.f_ptr = 0
+        self.f_out = 0.0
+        self.f_dirty = False
 
     def put(self, v: float) -> None:
         self.f_buf[self.f_ptr] = v
         self.f_ptr += 1
         if self.f_ptr == len(self.f_taps):
             self.f_ptr = 0
+        self.f_dirty = True
 
     def get(self) -> float:
+        if not self.f_dirty:
+            return self.f_out
         i = self.f_ptr - 1
         if i < 0:
             i += len(self.f_taps)
@@ -95,6 +102,8 @@ class FIRFilter:
             i -= 1
             if i < 0:
                 i += len(self.f_taps)
+        self.f_out = acc
+        self.f_dirty = False
         return acc
 
 
@@ -138,7 +147,7 @@ class ConnectionPool(FSM):
         if not callable(constructor):
             raise AssertionError('options.constructor must be callable')
 
-        self.p_uuid = str(mod_uuid.uuid4())
+        self.p_uuid = mod_utils.make_uuid()
         self.p_constructor = constructor
 
         domain = options.get('domain')
@@ -912,9 +921,10 @@ class ConnectionPool(FSM):
                                 self.p_codel.cd_targdelay)
                         hdl.timeout()
                         continue
-                    # Service is live again; waiters may remain queued
-                    # behind this one, so resume pacing.
-                    self._arm_codel_pacer()
+                    if self.p_codel is not None:
+                        # Service is live again; waiters may remain
+                        # queued behind this one, so resume pacing.
+                        self._arm_codel_pacer()
                     if hdl.ch_trace is not None:
                         if self.p_codel is not None:
                             hdl.ch_trace.codel_decision(
@@ -1069,7 +1079,7 @@ class ConnectionPool(FSM):
 
         e = mod_utils.maybe_capture_stack_trace()
 
-        handle = CueBallClaimHandle({
+        handle = obtain_claim_handle({
             'pool': self,
             'claimStack': e['stack'],
             'callback': cb,

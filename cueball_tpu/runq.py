@@ -47,9 +47,12 @@ and one FIFO.
 import asyncio
 import os
 
+from . import utils as mod_utils
 from .events import _native
 
-__all__ = ['defer', 'pump_enabled', 'set_pump_enabled']
+__all__ = ['defer', 'pump_enabled', 'set_pump_enabled', 'pump_depth',
+           'wheel_arm', 'wheel_cancel', 'wheel_depth',
+           'WHEEL_QUANTUM_MS']
 
 
 if _native is not None:
@@ -64,6 +67,8 @@ if _native is not None:
 
     def pump_enabled():
         return _pump_enabled()
+
+    pump_depth = _native.pump_depth
 else:
     _pending = {}  # loop -> list of (cb, *args) entry tuples
     _enabled = True
@@ -117,6 +122,97 @@ else:
     def pump_enabled():
         return _enabled
 
+    def pump_depth():
+        """Entries waiting in undrained pump batches (all loops) —
+        exported as the cueball_pump_queue_depth gauge."""
+        return sum(len(batch) for batch in _pending.values())
+
 
 if os.environ.get('CUEBALL_NO_PUMP'):
     set_pump_enabled(False)
+
+
+# -- batched claim-deadline timer wheel ----------------------------------
+#
+# Arming a per-claim asyncio timer costs a heapq push + Handle +
+# TimerHandle and, far worse, a heap pollution of cancelled entries for
+# every claim that completes in time (nearly all of them — round-6
+# profile, docs/claim-path-profile.md). The wheel coalesces claim
+# deadlines into WHEEL_QUANTUM_MS buckets with ONE loop.call_later per
+# bucket: arming and cancelling are plain dict ops, and a bucket's
+# single timer fires every handle that is still parked in it. Claim
+# timeouts are second-resolution liveness bounds, so up to one quantum
+# of firing slop is well inside spec (the FSM re-checks the real
+# deadline against current_millis() when it fires).
+
+WHEEL_QUANTUM_MS = 5.0
+
+_wheel: dict = {}  # loop -> {bucket: {token: handle}}
+_wheel_tok = 0
+
+
+def wheel_arm(deadline_ms, handle):
+    """Park `handle` until monotonic-ms `deadline_ms` rounds up to its
+    wheel bucket; returns an opaque token for wheel_cancel(). When the
+    bucket fires, `handle._ch_wheel_fire(token)` decides whether the
+    deadline still applies. Requires a running loop, like call_soon."""
+    global _wheel_tok
+    loop = asyncio.get_running_loop()
+    bucket = int(deadline_ms // WHEEL_QUANTUM_MS) + 1
+    buckets = _wheel.get(loop)
+    if buckets is None:
+        if _wheel:
+            # Prune buckets stranded on closed loops (their timers
+            # died with the loop), mirroring the pump's pruning.
+            for stale in [ln for ln in _wheel if ln.is_closed()]:
+                del _wheel[stale]
+        buckets = _wheel[loop] = {}
+    _wheel_tok += 1
+    token = (loop, bucket, _wheel_tok)
+    slot = buckets.get(bucket)
+    if slot is None:
+        slot = buckets[bucket] = {}
+        delay_ms = bucket * WHEEL_QUANTUM_MS - mod_utils.current_millis()
+        loop.call_later(max(delay_ms, 0.0) / 1000.0,
+                        _wheel_fire, loop, bucket)
+    slot[token] = handle
+    return token
+
+
+def _wheel_fire(loop, bucket):
+    buckets = _wheel.get(loop)
+    if buckets is None:
+        return
+    slot = buckets.pop(bucket, None)
+    if not buckets:
+        _wheel.pop(loop, None)
+    if not slot:
+        return
+    for token, handle in slot.items():
+        try:
+            handle._ch_wheel_fire(token)
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except BaseException as exc:
+            loop.call_exception_handler({
+                'message': 'cueball timer wheel deadline',
+                'exception': exc,
+            })
+
+
+def wheel_cancel(token):
+    """Unpark a handle; cancelling is two dict lookups and a pop — the
+    bucket's shared timer is left to fire and find nobody home."""
+    buckets = _wheel.get(token[0])
+    if buckets is None:
+        return
+    slot = buckets.get(token[1])
+    if slot is not None:
+        slot.pop(token, None)
+
+
+def wheel_depth():
+    """Handles currently parked in the wheel (all loops/buckets)."""
+    return sum(len(slot)
+               for buckets in _wheel.values()
+               for slot in buckets.values())

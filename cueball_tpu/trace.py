@@ -35,9 +35,43 @@ from __future__ import annotations
 import collections
 import json
 
+from . import runq as mod_runq
 from . import utils as mod_utils
+from .events import _native
 
 DEFAULT_RING_SIZE = 512
+
+# With the C engine loaded, the hot path doesn't build these objects at
+# all: trace.claim_begin hands the claim a NativeTrace token whose
+# methods append fixed-width slots to a preallocated C ring
+# (native/emitter.c, "Native trace recorder"), and the Python objects
+# below are assembled lazily at export by replaying the ring through
+# the SAME classes — which is what keeps the NDJSON byte-identical to
+# the pure-Python recorder. The event ring is sized as a multiple of
+# the completed-trace ring (a claim emits ~6 events).
+NATIVE_EVENTS_PER_TRACE = 16
+
+_NATIVE_TRACE_OK = _native is not None and \
+    hasattr(_native, 'trace_claim_begin')
+
+# Event codes — must match the TREV_* defines in native/emitter.c.
+_EV_CLAIM_BEGIN = 1
+_EV_CODEL = 2
+_EV_SLOT = 3
+_EV_CLAIMING = 4
+_EV_CLAIMED = 5
+_EV_REQUEUED = 6
+_EV_RELEASED = 7
+_EV_FAILED = 8
+_EV_CANCELLED = 9
+_EV_DNS_BEGIN = 10
+_EV_DNS_QBEGIN = 11
+_EV_DNS_QEND = 12
+_EV_DNS_DONE = 13
+
+# Cap on traces whose begin event has drained but whose terminal event
+# hasn't: protects the assembler against claims that never finish.
+_PENDING_MAX = 4096
 
 # Histograms the runtime feeds from completed spans (all milliseconds).
 TRACE_HISTOGRAMS = {
@@ -66,6 +100,18 @@ POOL_GAUGES = {
     'cueball_pending_slots': 'Connection slots still connecting',
 }
 
+# Self-observability of the recorder itself: the flight recorder must
+# say when it is dropping its own film.
+RING_DROPPED_COUNTER = 'cueball_trace_ring_dropped_total'
+RING_DROPPED_HELP = \
+    'Native trace-ring event slots overwritten before export'
+RING_GAUGES = {
+    'cueball_trace_ring_highwater':
+        'Peak undrained event slots in the native trace ring',
+    'cueball_pump_queue_depth':
+        'Callbacks waiting in the engine run-queue pump',
+}
+
 
 def _new_trace_id() -> str:
     return '%032x' % mod_utils.get_rng().getrandbits(128)
@@ -73,6 +119,25 @@ def _new_trace_id() -> str:
 
 def _new_span_id() -> str:
     return '%016x' % mod_utils.get_rng().getrandbits(64)
+
+
+_M64 = (1 << 64) - 1
+
+
+def _span_id_from(seed: int, index: int) -> str:
+    """Deterministic span id: splitmix64 of (trace seed, span index).
+
+    Span ids used to be independent RNG draws, which would make the
+    native recorder's lazily-assembled spans diverge from the pure
+    recorder's (the draws happen at different times). Deriving them
+    from the trace id — itself still one RNG draw — makes the id a
+    pure function of (trace, position), so both recorders emit
+    byte-identical NDJSON while consuming identical RNG streams."""
+    z = (seed + (index + 1) * 0x9E3779B97F4A7C15) & _M64
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+    z ^= z >> 31
+    return '%016x' % z
 
 
 class Span:
@@ -83,9 +148,10 @@ class Span:
                  'attrs')
 
     def __init__(self, name: str, parent_span_id: str | None,
-                 start: float, attrs: dict | None = None):
+                 start: float, attrs: dict | None = None,
+                 span_id: str | None = None):
         self.name = name
-        self.span_id = _new_span_id()
+        self.span_id = _new_span_id() if span_id is None else span_id
         self.parent_span_id = parent_span_id
         self.start = start
         self.end = None
@@ -100,37 +166,49 @@ class Span:
 class Trace:
     """A flat span list sharing one trace_id; spans[0] is the root."""
 
-    __slots__ = ('trace_id', 'spans', 'tr_runtime')
+    __slots__ = ('trace_id', 'spans', 'tr_runtime', 'tr_sid_seed')
 
     root_name = 'trace'
 
     def __init__(self, runtime: '_TraceRuntime', attrs: dict | None = None,
-                 start: float | None = None):
-        self.trace_id = _new_trace_id()
+                 start: float | None = None,
+                 trace_id_int: int | None = None):
+        if trace_id_int is None:
+            trace_id_int = mod_utils.get_rng().getrandbits(128)
+        self.trace_id = '%032x' % trace_id_int
+        self.tr_sid_seed = trace_id_int & _M64
         self.tr_runtime = runtime
         if start is None:
             start = mod_utils.current_millis()
-        self.spans = [Span(self.root_name, None, start, attrs)]
+        self.spans = []
+        self._new_span(self.root_name, None, start, attrs)
 
     @property
     def root(self) -> Span:
         return self.spans[0]
 
+    def _new_span(self, name: str, parent_span_id: str | None,
+                  start: float, attrs: dict | None = None) -> Span:
+        span = Span(name, parent_span_id, start, attrs,
+                    span_id=_span_id_from(self.tr_sid_seed,
+                                          len(self.spans)))
+        self.spans.append(span)
+        return span
+
     def begin_span(self, name: str, attrs: dict | None = None,
                    start: float | None = None) -> Span:
         if start is None:
             start = mod_utils.current_millis()
-        span = Span(name, self.root.span_id, start, attrs)
-        self.spans.append(span)
-        return span
+        return self._new_span(name, self.root.span_id, start, attrs)
 
     def end_span(self, span: Span, end: float | None = None) -> None:
         if span.end is None:
             span.end = mod_utils.current_millis() if end is None else end
 
-    def add_event(self, name: str, attrs: dict | None = None) -> Span:
+    def add_event(self, name: str, attrs: dict | None = None,
+                  now: float | None = None) -> Span:
         """A zero-duration decision/event span (end == start)."""
-        span = self.begin_span(name, attrs)
+        span = self.begin_span(name, attrs, start=now)
         span.end = span.start
         return span
 
@@ -182,43 +260,47 @@ class ClaimTrace(Trace):
     root_name = 'claim'
 
     def __init__(self, runtime: '_TraceRuntime', pool,
-                 start: float | None = None):
+                 start: float | None = None,
+                 trace_id_int: int | None = None,
+                 ident: tuple | None = None):
         # 'pool' may be a ConnectionPool or a ConnectionSet standing in
         # as one (cset claims hand the set itself down), so everything
-        # here is getattr-guarded.
-        uuid = getattr(pool, 'p_uuid', None) or \
-            getattr(pool, 'cs_uuid', None) or ''
-        domain = getattr(pool, 'p_domain', None) or \
-            getattr(pool, 'cs_domain', None) or ''
+        # here is getattr-guarded. Replay passes the (pool, domain)
+        # identity captured at emit time instead of the live object.
+        if ident is None:
+            uuid = getattr(pool, 'p_uuid', None) or \
+                getattr(pool, 'cs_uuid', None) or ''
+            domain = getattr(pool, 'p_domain', None) or \
+                getattr(pool, 'cs_domain', None) or ''
+            ident = (str(uuid), str(domain))
         Trace.__init__(self, runtime, {
             'kind': 'claim',
-            'pool': str(uuid),
-            'domain': str(domain),
-        }, start=start)
+            'pool': ident[0],
+            'domain': ident[1],
+        }, start=start, trace_id_int=trace_id_int)
         self.ct_queue_span = self.begin_span('queue_wait',
                                              start=self.root.start)
         self.ct_handshake_span = None
         self.ct_lease_span = None
 
     def codel_decision(self, decision: str, sojourn_ms: float,
-                       target_ms: float) -> None:
+                       target_ms: float, now: float | None = None) -> None:
         self.add_event('codel', {
             'decision': decision,
             'sojourn_ms': round(float(sojourn_ms), 3),
             'target_ms': float(target_ms),
-        })
+        }, now=now)
 
-    def slot_selected(self, source: str) -> None:
-        self.add_event('slot_select', {'source': source})
+    def slot_selected(self, source: str, now: float | None = None) -> None:
+        self.add_event('slot_select', {'source': source}, now=now)
 
     def claiming(self, slot) -> None:
         """Queue wait is over; the claim handshake with `slot` begins.
         The serving slot's last connect is attached as a child span so
         the trace shows where connect time went even when the connect
         predates the claim (attrs.during_claim says which)."""
-        now = mod_utils.current_millis()
-        self.end_span(self.ct_queue_span, now)
         backend = ''
+        last = None
         smgr = None
         get_smgr = getattr(slot, 'get_socket_mgr', None)
         if get_smgr is not None:
@@ -229,50 +311,65 @@ class ClaimTrace(Trace):
             last = getattr(smgr, 'sm_last_connect', None)
             if last is not None:
                 cstart, cend = last
-                span = Span('connect', self.root.span_id, cstart,
-                            {'backend': backend,
-                             'during_claim': cend >= self.root.start})
-                span.end = cend
-                self.spans.append(span)
+                last = (cstart, cend)
+        self._claiming_at(backend, last, mod_utils.current_millis())
+
+    def _claiming_at(self, backend: str, last: tuple | None,
+                     now: float) -> None:
+        self.end_span(self.ct_queue_span, now)
+        if last is not None:
+            cstart, cend = last
+            span = self._new_span(
+                'connect', self.root.span_id, cstart,
+                {'backend': backend,
+                 'during_claim': cend >= self.root.start})
+            span.end = cend
         self.ct_handshake_span = self.begin_span(
             'handshake', {'backend': backend}, start=now)
 
-    def claimed(self) -> None:
-        now = mod_utils.current_millis()
+    def claimed(self, now: float | None = None) -> None:
+        if now is None:
+            now = mod_utils.current_millis()
         if self.ct_handshake_span is not None:
             self.end_span(self.ct_handshake_span, now)
         self.ct_lease_span = self.begin_span('lease', start=now)
 
-    def requeued(self) -> None:
+    def requeued(self, now: float | None = None) -> None:
         """The slot rejected the handshake; the claim is back in the
         queue. Only meaningful when a handshake was open."""
         if self.ct_handshake_span is None:
             return
-        now = mod_utils.current_millis()
+        if now is None:
+            now = mod_utils.current_millis()
         if self.ct_handshake_span.end is None:
             self.ct_handshake_span.attrs['outcome'] = 'rejected'
             self.end_span(self.ct_handshake_span, now)
         self.ct_handshake_span = None
-        self.add_event('requeue')
+        self.add_event('requeue', now=now)
         self.ct_queue_span = self.begin_span(
             'queue_wait', {'requeue': True}, start=now)
 
-    def released(self, how: str) -> None:
-        now = mod_utils.current_millis()
+    def released(self, how: str, now: float | None = None) -> None:
+        if now is None:
+            now = mod_utils.current_millis()
         if self.ct_lease_span is not None:
             self.end_span(self.ct_lease_span, now)
         if self.root.end is None:
-            self.add_event('release', {'how': how})
+            self.add_event('release', {'how': how}, now=now)
         self.finish('released' if how == 'release' else 'closed',
                     end=now)
 
     def failed(self, err) -> None:
-        if err is not None:
-            self.root.attrs['error'] = type(err).__name__
-        self.finish('failed')
+        self._fail_named(type(err).__name__ if err is not None else None)
 
-    def cancelled(self) -> None:
-        self.finish('cancelled')
+    def _fail_named(self, errname: str | None,
+                    now: float | None = None) -> None:
+        if errname is not None:
+            self.root.attrs['error'] = errname
+        self.finish('failed', end=now)
+
+    def cancelled(self, now: float | None = None) -> None:
+        self.finish('cancelled', end=now)
 
 
 class DnsTrace(Trace):
@@ -283,24 +380,34 @@ class DnsTrace(Trace):
 
     root_name = 'dns_lookup'
 
-    def __init__(self, runtime: '_TraceRuntime', domain: str, rtype: str):
+    def __init__(self, runtime: '_TraceRuntime', domain: str, rtype: str,
+                 start: float | None = None,
+                 trace_id_int: int | None = None):
         Trace.__init__(self, runtime, {
             'kind': 'dns',
             'domain': str(domain),
             'type': str(rtype),
-        })
+        }, start=start, trace_id_int=trace_id_int)
 
-    def query_begin(self, resolver: str) -> Span:
-        return self.begin_span('dns_query', {'resolver': str(resolver)})
+    def query_begin(self, resolver: str,
+                    now: float | None = None) -> Span:
+        return self.begin_span('dns_query', {'resolver': str(resolver)},
+                               start=now)
 
-    def query_end(self, span: Span, outcome: str) -> None:
+    def query_end(self, span: Span, outcome: str,
+                  now: float | None = None) -> None:
         span.attrs['outcome'] = outcome
-        self.end_span(span)
+        self.end_span(span, end=now)
 
     def done(self, outcome: str, err=None) -> None:
-        if err is not None:
-            self.root.attrs['error'] = type(err).__name__
-        self.finish(outcome)
+        self._done_named(outcome,
+                         type(err).__name__ if err is not None else None)
+
+    def _done_named(self, outcome: str, errname: str | None,
+                    now: float | None = None) -> None:
+        if errname is not None:
+            self.root.attrs['error'] = errname
+        self.finish(outcome, end=now)
 
 
 class _GaugeRow:
@@ -325,11 +432,15 @@ class _TraceRuntime:
     sampling decision, and the optional metric aggregation."""
 
     def __init__(self, ring_size: int = DEFAULT_RING_SIZE,
-                 sample_rate: float = 1.0, collector=None):
+                 sample_rate: float = 1.0, collector=None,
+                 native: bool | None = None):
         if ring_size < 1:
             raise ValueError('ring_size must be >= 1')
         if not 0.0 <= sample_rate <= 1.0:
             raise ValueError('sample_rate must be within [0, 1]')
+        if native is None:
+            native = _NATIVE_TRACE_OK
+        self.tr_native = bool(native) and _NATIVE_TRACE_OK
         self.tr_ring: collections.deque = collections.deque(
             maxlen=int(ring_size))
         self.tr_sample = float(sample_rate)
@@ -338,13 +449,30 @@ class _TraceRuntime:
         self.tr_sampled = 0
         self.tr_rows: dict = {}
         self.tr_generation = None
+        # Traces whose begin event has drained but whose terminal event
+        # hasn't: serial -> [trace, dns_query_token_map_or_None].
+        self.tr_pending: dict = {}
+        self.tr_truncated = 0
+        self.tr_evicted = 0
+        self.tr_dropped_reported = 0
         if collector is not None:
             for name, help_ in TRACE_HISTOGRAMS.items():
                 collector.histogram(name, help=help_)
             collector.counter(SHED_COUNTER, help=SHED_HELP)
+            collector.counter(RING_DROPPED_COUNTER,
+                              help=RING_DROPPED_HELP)
             for name, help_ in POOL_GAUGES.items():
                 collector.gauge(name, help=help_)
+            for name, help_ in RING_GAUGES.items():
+                collector.gauge(name, help=help_)
             collector.add_collect_hook(self.refresh_gauges)
+        if self.tr_native:
+            _native.trace_ring_configure(
+                int(ring_size) * NATIVE_EVENTS_PER_TRACE)
+            _sync_native_clock()
+            # Bound module functions cached for the per-claim path.
+            self.tr_nclaim = _native.trace_claim_begin
+            self.tr_ndns = _native.trace_dns_begin
 
     # -- sampling ---------------------------------------------------------
 
@@ -364,9 +492,40 @@ class _TraceRuntime:
     # -- claim-path hooks (called from pool / connection_fsm / cset) ------
 
     def claim_begin(self, handle, pool) -> None:
-        if self._sampled():
-            handle.ch_trace = ClaimTrace(
-                self, pool, start=getattr(handle, 'ch_started', None))
+        # _sampled() inlined: this runs once per claim at rate 1.0.
+        self.tr_seen += 1
+        rate = self.tr_sample
+        if rate < 1.0:
+            if rate <= 0.0 or \
+                    not mod_utils.get_rng().random() < rate:
+                return
+        self.tr_sampled += 1
+        start = getattr(handle, 'ch_started', None)
+        if self.tr_native:
+            try:
+                ident = pool._tr_claim_ident
+            except AttributeError:
+                ident = self._claim_ident(pool)
+            if start is None:
+                start = mod_utils.current_millis()
+            handle.ch_trace = self.tr_nclaim(
+                (mod_utils.get_rng().getrandbits(128), ident), start)
+        else:
+            handle.ch_trace = ClaimTrace(self, pool, start=start)
+
+    def _claim_ident(self, pool) -> tuple:
+        """(pool uuid, domain) as strings, cached on the pool so the
+        native fast path pays one attribute load instead of four."""
+        uuid = getattr(pool, 'p_uuid', None) or \
+            getattr(pool, 'cs_uuid', None) or ''
+        domain = getattr(pool, 'p_domain', None) or \
+            getattr(pool, 'cs_domain', None) or ''
+        ident = (str(uuid), str(domain))
+        try:
+            pool._tr_claim_ident = ident
+        except (AttributeError, TypeError):
+            pass
+        return ident
 
     def connect_done(self, backend_key, start: float, end: float) -> None:
         self.observe('cueball_connect_ms', end - start)
@@ -380,9 +539,14 @@ class _TraceRuntime:
         if trace is not None:
             trace.codel_decision('shed-' + reason, sojourn_ms, target_ms)
 
-    def dns_begin(self, domain: str, rtype: str) -> DnsTrace | None:
+    def dns_begin(self, domain: str, rtype: str):
         if not self._sampled():
             return None
+        if self.tr_native:
+            return self.tr_ndns(
+                (mod_utils.get_rng().getrandbits(128),
+                 str(domain), str(rtype)),
+                mod_utils.current_millis())
         return DnsTrace(self, domain, rtype)
 
     def observe(self, name: str, value_ms: float) -> None:
@@ -394,6 +558,8 @@ class _TraceRuntime:
     # -- completion -------------------------------------------------------
 
     def completed(self, trace: Trace) -> None:
+        if len(self.tr_ring) == self.tr_ring.maxlen:
+            self.tr_evicted += 1
         self.tr_ring.append(trace)
         if self.tr_collector is None:
             return
@@ -408,6 +574,112 @@ class _TraceRuntime:
         elif isinstance(trace, DnsTrace):
             self.observe('cueball_dns_lookup_ms', trace.root.duration())
 
+    # -- native ring drain ------------------------------------------------
+
+    def _drain_native(self) -> None:
+        """Replay the C event ring through the real trace classes.
+
+        This is the lazy half of the native recorder: the hot path
+        wrote fixed-width slots; here — only at export/scrape — those
+        slots are replayed through the SAME ClaimTrace/DnsTrace methods
+        the pure recorder drives inline, with the recorded timestamps
+        passed as now=/start=. Byte-identical NDJSON by construction.
+
+        Terminal events deliberately do NOT remove the pending entry:
+        terminal states can chain (released -> closed) and finish() is
+        idempotent, so a later terminal event on the same serial must
+        still find its trace. Entries age out of the bounded pending
+        map instead; an unfinished trace evicted that way (or an event
+        whose begin slot was already overwritten) counts as truncated."""
+        if not self.tr_native:
+            return
+        events = _native.trace_ring_drain()
+        if not events:
+            return
+        pending = self.tr_pending
+        for code, serial, t, a, b, obj, flags in events:
+            if code == _EV_CLAIM_BEGIN:
+                tid, ident = obj
+                pending[serial] = [
+                    ClaimTrace(self, None, start=t,
+                               trace_id_int=tid, ident=ident),
+                    None,
+                ]
+            elif code == _EV_DNS_BEGIN:
+                tid, domain, rtype = obj
+                pending[serial] = [
+                    DnsTrace(self, domain, rtype, start=t,
+                             trace_id_int=tid),
+                    None,
+                ]
+            else:
+                ent = pending.get(serial)
+                if ent is None:
+                    self.tr_truncated += 1
+                    continue
+                trace = ent[0]
+                if code == _EV_CODEL:
+                    trace.codel_decision(obj, a, b, now=t)
+                elif code == _EV_SLOT:
+                    trace.slot_selected(obj, now=t)
+                elif code == _EV_CLAIMING:
+                    trace._claiming_at(
+                        obj, (a, b) if flags & 1 else None, t)
+                elif code == _EV_CLAIMED:
+                    trace.claimed(now=t)
+                elif code == _EV_REQUEUED:
+                    trace.requeued(now=t)
+                elif code == _EV_RELEASED:
+                    trace.released(obj, now=t)
+                elif code == _EV_FAILED:
+                    trace._fail_named(obj, now=t)
+                elif code == _EV_CANCELLED:
+                    trace.cancelled(now=t)
+                elif code == _EV_DNS_QBEGIN:
+                    qmap = ent[1]
+                    if qmap is None:
+                        qmap = ent[1] = {}
+                    qmap[int(a)] = trace.query_begin(obj, now=t)
+                elif code == _EV_DNS_QEND:
+                    qmap = ent[1]
+                    span = qmap.pop(int(a), None) if qmap else None
+                    if span is not None:
+                        trace.query_end(span, obj, now=t)
+                elif code == _EV_DNS_DONE:
+                    outcome, errname = obj
+                    trace._done_named(outcome, errname, now=t)
+                continue
+            if len(pending) > _PENDING_MAX:
+                ent = pending.pop(next(iter(pending)))
+                if ent[0].root.end is None:
+                    self.tr_truncated += 1
+
+    def _refresh_ring_health(self) -> None:
+        """Scrape-time ring self-observability: dropped-slot counter
+        (delta-exported from the C ring's monotonic total), undrained
+        high-water gauge, and the run-queue pump depth."""
+        if self.tr_collector is None:
+            return
+        highwater = 0
+        if self.tr_native:
+            stats = _native.trace_ring_stats()
+            dropped = stats['dropped']
+            delta = dropped - self.tr_dropped_reported
+            if delta > 0:
+                self.tr_dropped_reported = dropped
+                self.tr_collector.counter(
+                    RING_DROPPED_COUNTER, help=RING_DROPPED_HELP) \
+                    .increment(value=delta)
+            highwater = stats['highwater']
+        self.tr_collector.gauge(
+            'cueball_trace_ring_highwater',
+            help=RING_GAUGES['cueball_trace_ring_highwater']) \
+            .set(highwater)
+        self.tr_collector.gauge(
+            'cueball_pump_queue_depth',
+            help=RING_GAUGES['cueball_pump_queue_depth']) \
+            .set(mod_runq.pump_depth())
+
     # -- per-pool gauges --------------------------------------------------
 
     def refresh_gauges(self) -> None:
@@ -416,6 +688,8 @@ class _TraceRuntime:
         gauges only for pools whose telemetry row was marked dirty."""
         if self.tr_collector is None:
             return
+        self._drain_native()
+        self._refresh_ring_health()
         from . import monitor as mod_monitor
         mon = mod_monitor.pool_monitor
         gen = mon.pm_generation
@@ -471,6 +745,11 @@ class _TraceRuntime:
             self._drop_row(uuid)
         if self.tr_collector is not None:
             self.tr_collector.remove_collect_hook(self.refresh_gauges)
+        if self.tr_native:
+            mod_utils.remove_clock_hook(_sync_native_clock)
+            _native.trace_ring_configure(0)
+            _native.trace_set_clock(None)
+            self.tr_pending.clear()
 
 
 # The one per-process runtime; None when tracing is off. Hot-path call
@@ -479,16 +758,36 @@ class _TraceRuntime:
 _runtime: _TraceRuntime | None = None
 
 
+def _sync_native_clock(*_clock) -> None:
+    """Keep the C recorder on the same clock as utils.current_millis():
+    under the real SystemClock the C side reads CLOCK_MONOTONIC
+    directly (no Python in the hot path); any substituted clock
+    (netsim's VirtualClock) routes through a Python callback so
+    virtual-time traces stay parity-exact. Registered as a
+    utils.add_clock_hook so mid-run set_clock() switches follow."""
+    if not _NATIVE_TRACE_OK:
+        return
+    if isinstance(mod_utils.get_clock(), mod_utils.SystemClock):
+        _native.trace_set_clock(None)
+    else:
+        _native.trace_set_clock(mod_utils.current_millis)
+
+
 def enable_tracing(ring_size: int = DEFAULT_RING_SIZE,
                    sample_rate: float = 1.0,
-                   collector=None) -> _TraceRuntime:
+                   collector=None,
+                   native: bool | None = None) -> _TraceRuntime:
     """Turn on claim-path tracing process-wide. `collector` (a
     metrics.Collector) is optional: without one, traces land in the
-    ring and on /kang/traces but no histograms/gauges are fed."""
+    ring and on /kang/traces but no histograms/gauges are fed.
+    `native` selects the C event-ring recorder (None = use it whenever
+    the C engine is loaded; False forces the pure-Python recorder)."""
     global _runtime
     if _runtime is not None:
         disable_tracing()
-    _runtime = _TraceRuntime(ring_size, sample_rate, collector)
+    _runtime = _TraceRuntime(ring_size, sample_rate, collector, native)
+    if _runtime.tr_native:
+        mod_utils.add_clock_hook(_sync_native_clock)
     return _runtime
 
 
@@ -516,7 +815,10 @@ def active_collector():
 def trace_ring() -> list:
     """Completed traces, oldest first (a copy; safe to iterate)."""
     runtime = _runtime
-    return list(runtime.tr_ring) if runtime is not None else []
+    if runtime is None:
+        return []
+    runtime._drain_native()
+    return list(runtime.tr_ring)
 
 
 def export_ndjson() -> str:
@@ -525,6 +827,7 @@ def export_ndjson() -> str:
     runtime = _runtime
     if runtime is None:
         return ''
+    runtime._drain_native()
     lines: list = []
     for trace in runtime.tr_ring:
         lines.extend(trace.ndjson_lines())
@@ -552,6 +855,7 @@ def summary() -> dict:
     if runtime is None:
         out = {'enabled': False}
     else:
+        runtime._drain_native()
         out = {
             'enabled': True,
             'ring': len(runtime.tr_ring),
@@ -559,7 +863,12 @@ def summary() -> dict:
             'sample_rate': runtime.tr_sample,
             'seen': runtime.tr_seen,
             'sampled': runtime.tr_sampled,
+            'native': runtime.tr_native,
+            'evicted': runtime.tr_evicted,
+            'truncated': runtime.tr_truncated,
         }
+        if runtime.tr_native:
+            out['native_ring'] = dict(_native.trace_ring_stats())
     if _run_metadata:
         out['run'] = dict(_run_metadata)
     return out
@@ -570,7 +879,10 @@ def dump_traces(limit: int = 8) -> str:
     completed traces with their per-span breakdown. '' when tracing is
     off or the ring is empty."""
     runtime = _runtime
-    if runtime is None or not runtime.tr_ring:
+    if runtime is None:
+        return ''
+    runtime._drain_native()
+    if not runtime.tr_ring:
         return ''
     traces = sorted(runtime.tr_ring,
                     key=lambda t: t.root.duration() or 0.0,
@@ -578,6 +890,13 @@ def dump_traces(limit: int = 8) -> str:
     out = ['-- claim traces (%d slowest of %d in ring; '
            'sample_rate=%g) --' %
            (len(traces), len(runtime.tr_ring), runtime.tr_sample)]
+    if runtime.tr_native:
+        stats = _native.trace_ring_stats()
+        out.append('  native ring: cap=%d pending=%d dropped=%d '
+                   'highwater=%d truncated=%d' %
+                   (stats['capacity'], stats['pending'],
+                    stats['dropped'], stats['highwater'],
+                    runtime.tr_truncated))
     for trace in traces:
         root = trace.root
         parts = ['%s=%.1f' % (name, ms)

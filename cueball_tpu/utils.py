@@ -261,6 +261,25 @@ _clock = SystemClock()
 _rng = random  # module default: the global `random` stream
 
 
+# Consumers that cache clock-derived state (the native trace recorder
+# reads CLOCK_MONOTONIC directly unless a virtual clock is installed)
+# register here to be told when the clock seam changes.
+_clock_hooks: list = []
+
+
+def add_clock_hook(fn) -> None:
+    """Call ``fn(clock)`` after every set_clock(); idempotent."""
+    if fn not in _clock_hooks:
+        _clock_hooks.append(fn)
+
+
+def remove_clock_hook(fn) -> None:
+    try:
+        _clock_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
 def set_clock(clock) -> object:
     """Install a process-wide clock (an object with .monotonic() and
     .wall(), both in seconds); returns the previous clock so callers
@@ -268,6 +287,8 @@ def set_clock(clock) -> object:
     global _clock
     old = _clock
     _clock = clock
+    for fn in list(_clock_hooks):
+        fn(clock)
     return old
 
 
@@ -289,6 +310,19 @@ def set_rng(rng) -> object:
 
 def get_rng():
     return _rng
+
+
+def make_uuid() -> str:
+    """A random version-4 UUID string drawn from the RNG seam, so
+    pool/set/resolver identities are reproducible under netsim's
+    seeded runs (uuid.uuid4() would read os.urandom and make
+    otherwise-deterministic trace exports differ run to run)."""
+    bits = _rng.getrandbits(128)
+    bits = (bits & ~(0xf << 76)) | (0x4 << 76)       # version 4
+    bits = (bits & ~(0x3 << 62)) | (0x2 << 62)       # RFC 4122 variant
+    h = '%032x' % bits
+    return '%s-%s-%s-%s-%s' % (h[:8], h[8:12], h[12:16], h[16:20],
+                               h[20:])
 
 
 def current_millis() -> float:

@@ -27,6 +27,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 #include <structmember.h>
+#include <stdint.h>
 #include <time.h>
 
 /* Python < 3.12 compatibility: the single-object exception API this
@@ -337,11 +338,32 @@ SHandle_clear_(SHandleObject *self)
     return 0;
 }
 
+/* Freelist for the stock fsm.py StateHandle subclass: one SHandle is
+   allocated and freed per FSM transition (several per claim/release
+   cycle), so recycling the shells is a measurable claim-path win
+   (docs/claim-path-profile.md).  Only instances whose exact type is
+   `shandle_fast_class` — validated in fsm_configure to have the stock
+   layout (no extra slots, no dict, no custom __init__/__new__) — are
+   stashed.  Stashed shells sit at refcount 0, untracked, with all
+   fields cleared; shandle_create() resurrects them.  Note
+   subtype_dealloc Py_DECREFs the heap type after the base dealloc
+   returns, so resurrection re-INCREFs it (shandle_fast_class keeps the
+   type alive in between). */
+#define SHANDLE_FREE_CAP 80
+static SHandleObject *shandle_free[SHANDLE_FREE_CAP];
+static int shandle_free_n = 0;
+static PyObject *shandle_fast_class = NULL;
+
 static void
 SHandle_dealloc(SHandleObject *self)
 {
     PyObject_GC_UnTrack(self);
     SHandle_clear_(self);
+    if ((PyObject *)Py_TYPE(self) == shandle_fast_class &&
+        shandle_free_n < SHANDLE_FREE_CAP) {
+        shandle_free[shandle_free_n++] = self;
+        return;
+    }
     Py_TYPE(self)->tp_free((PyObject *)self);
 }
 
@@ -1642,7 +1664,57 @@ fsm_configure(PyObject *mod, PyObject *args)
         Py_INCREF(run_thin);
         Py_XSETREF(fsm_run_thin, run_thin);
     }
+    /* (Re)arm the SHandle freelist.  Stashed shells belong to the
+       previously configured class: discard them (they own no refs —
+       fields were cleared and subtype_dealloc already dropped the type
+       ref at stash time), then accept the new class only if it has the
+       exact stock layout the resurrection path assumes. */
+    while (shandle_free_n > 0)
+        PyObject_GC_Del(shandle_free[--shandle_free_n]);
+    Py_CLEAR(shandle_fast_class);
+    if (PyType_Check(handle_cls)) {
+        PyTypeObject *t = (PyTypeObject *)handle_cls;
+        if (PyType_IsSubtype(t, &SHandle_Type) &&
+            t->tp_basicsize == SHandle_Type.tp_basicsize &&
+            t->tp_itemsize == 0 &&
+            t->tp_init == SHandle_Type.tp_init &&
+            t->tp_new == PyType_GenericNew &&
+            t->tp_dealloc != (destructor)SHandle_dealloc &&
+            t->tp_dictoffset == 0 &&
+            t->tp_weaklistoffset == 0) {
+            Py_INCREF(handle_cls);
+            shandle_fast_class = handle_cls;
+        }
+    }
     Py_RETURN_NONE;
+}
+
+/* Allocate (or resurrect) a state handle of the configured class.
+   Falls back to the general constructor call whenever the freelist is
+   empty or disabled. */
+static PyObject *
+shandle_create(PyObject *fsm, PyObject *state)
+{
+    if (shandle_free_n > 0) {
+        PyObject *lst = PyList_New(0);
+        if (lst == NULL)
+            return NULL;
+        SHandleObject *h = shandle_free[--shandle_free_n];
+        _Py_NewReference((PyObject *)h);
+        Py_INCREF(shandle_fast_class);  /* undo subtype_dealloc's drop */
+        Py_INCREF(fsm);
+        h->sh_fsm = fsm;
+        Py_INCREF(state);
+        h->sh_state = state;
+        h->sh_disposables = lst;
+        Py_INCREF(Py_None);
+        h->sh_valid = Py_None;
+        h->sh_transitioned = 0;
+        PyObject_GC_Track((PyObject *)h);
+        return (PyObject *)h;
+    }
+    return PyObject_CallFunctionObjArgs(fsm_handle_class, fsm, state,
+                                        NULL);
 }
 
 /* True when type(fsm)'s `name` resolves to the configured stock
@@ -1878,8 +1950,7 @@ fsm_run_transition_impl(PyObject *fsm, PyObject *state)
 
     /* New handle becomes current before the entry function runs. */
     {
-        PyObject *handle = PyObject_CallFunctionObjArgs(
-            fsm_handle_class, fsm, state, NULL);
+        PyObject *handle = shandle_create(fsm, state);
         if (handle == NULL)
             goto fail;
         if (fsm_field_set(fsm, str_fsm_state_handle, handle) < 0) {
@@ -2152,6 +2223,764 @@ fsm_goto_state(PyObject *mod, PyObject *args)
 }
 
 /* ------------------------------------------------------------------ */
+/* Native trace recorder                                               */
+/*                                                                     */
+/* The hot-path half of cueball_tpu/trace.py: instead of building      */
+/* ClaimTrace/DnsTrace/Span objects per claim, the claim path holds a  */
+/* tiny NativeTrace token and every tracer method appends ONE fixed-   */
+/* width slot (event code, serial, timestamp, two doubles, one         */
+/* PyObject payload) to a preallocated per-process ring.  Python       */
+/* replays the ring through the real trace classes lazily at export    */
+/* (trace.py _drain_native), which is what makes the NDJSON byte-      */
+/* identical to the pure-Python recorder.  Single-writer under the     */
+/* GIL; when full the OLDEST slot is overwritten (flight-recorder      */
+/* semantics) and the drop is counted.                                 */
+
+#define TREV_CLAIM_BEGIN 1   /* obj=(trace_id_int, (pool, domain))    */
+#define TREV_CODEL       2   /* obj=decision, a=sojourn_ms, b=target  */
+#define TREV_SLOT        3   /* obj=source                            */
+#define TREV_CLAIMING    4   /* obj=backend str, a/b=connect start/   */
+                             /* end, flags bit0 = has_connect         */
+#define TREV_CLAIMED     5
+#define TREV_REQUEUED    6
+#define TREV_RELEASED    7   /* obj=how                               */
+#define TREV_FAILED      8   /* obj=type(err).__name__ or None        */
+#define TREV_CANCELLED   9
+#define TREV_DNS_BEGIN   10  /* obj=(trace_id_int, domain, rtype)     */
+#define TREV_DNS_QBEGIN  11  /* obj=resolver, a=token                 */
+#define TREV_DNS_QEND    12  /* obj=outcome,  a=token                 */
+#define TREV_DNS_DONE    13  /* obj=(outcome, errname or None)        */
+
+typedef struct {
+    uint32_t ts_code;
+    uint32_t ts_flags;
+    uint64_t ts_serial;
+    double ts_t;
+    double ts_a;
+    double ts_b;
+    PyObject *ts_obj;
+} TraceSlot;
+
+static TraceSlot *trace_slots = NULL;
+static Py_ssize_t trace_cap = 0;
+static uint64_t trace_head = 0;        /* next write position        */
+static uint64_t trace_tail = 0;        /* oldest undrained slot      */
+static unsigned long long trace_dropped = 0;
+static Py_ssize_t trace_highwater = 0;
+static uint64_t trace_serial_next = 1; /* NEVER reset: stale tokens  */
+                                       /* from a previous enable     */
+                                       /* must not alias new traces  */
+static PyObject *trace_clock_fn = NULL;
+
+static PyObject *str_get_socket_mgr;
+static PyObject *str_csf_smgr;
+static PyObject *str_sm_backend;
+static PyObject *str_sm_last_connect;
+static PyObject *str_key;
+static PyObject *str_get;
+static PyObject *str_name_dunder;
+static PyObject *str_empty;
+
+/* Monotonic milliseconds — the same clock (and float arithmetic) as
+   utils.current_millis.  When a non-system clock is installed through
+   utils.set_clock (netsim virtual time), trace.py hands us
+   current_millis itself so recorded stamps match the pure recorder
+   bit-for-bit. */
+static double
+trace_now(int *err)
+{
+    if (trace_clock_fn != NULL) {
+        PyObject *r = PyObject_CallNoArgs(trace_clock_fn);
+        if (r == NULL) {
+            *err = 1;
+            return 0.0;
+        }
+        double v = PyFloat_AsDouble(r);
+        Py_DECREF(r);
+        if (v == -1.0 && PyErr_Occurred()) {
+            *err = 1;
+            return 0.0;
+        }
+        return v;
+    }
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec * 1000.0 + (double)ts.tv_nsec / 1e6;
+}
+
+/* Append one slot; steals the reference to `obj` (which may be NULL).
+   No-op when the ring is unconfigured — in-flight NativeTrace tokens
+   outliving disable_tracing() land here. */
+static void
+trace_emit(uint64_t serial, uint32_t code, uint32_t flags,
+           double t, double a, double b, PyObject *obj)
+{
+    if (trace_cap == 0) {
+        Py_XDECREF(obj);
+        return;
+    }
+    if ((Py_ssize_t)(trace_head - trace_tail) == trace_cap) {
+        TraceSlot *old = &trace_slots[trace_tail % (uint64_t)trace_cap];
+        PyObject *dead = old->ts_obj;
+        old->ts_obj = NULL;
+        trace_tail++;
+        trace_dropped++;
+        Py_XDECREF(dead);
+    }
+    TraceSlot *s = &trace_slots[trace_head % (uint64_t)trace_cap];
+    s->ts_code = code;
+    s->ts_flags = flags;
+    s->ts_serial = serial;
+    s->ts_t = t;
+    s->ts_a = a;
+    s->ts_b = b;
+    s->ts_obj = obj;
+    trace_head++;
+    if ((Py_ssize_t)(trace_head - trace_tail) > trace_highwater)
+        trace_highwater = (Py_ssize_t)(trace_head - trace_tail);
+}
+
+static PyObject *
+trace_ring_configure(PyObject *mod, PyObject *arg)
+{
+    (void)mod;
+    Py_ssize_t cap = PyNumber_AsSsize_t(arg, PyExc_OverflowError);
+    if (cap == -1 && PyErr_Occurred())
+        return NULL;
+    if (cap < 0) {
+        PyErr_SetString(PyExc_ValueError, "ring capacity must be >= 0");
+        return NULL;
+    }
+    if (trace_cap > 0) {
+        for (uint64_t i = trace_tail; i != trace_head; i++)
+            Py_CLEAR(trace_slots[i % (uint64_t)trace_cap].ts_obj);
+        PyMem_Free(trace_slots);
+    }
+    trace_slots = NULL;
+    trace_cap = 0;
+    trace_head = trace_tail = 0;
+    trace_dropped = 0;
+    trace_highwater = 0;
+    if (cap > 0) {
+        trace_slots = PyMem_Calloc((size_t)cap, sizeof(TraceSlot));
+        if (trace_slots == NULL)
+            return PyErr_NoMemory();
+        trace_cap = cap;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+trace_set_clock(PyObject *mod, PyObject *fn)
+{
+    (void)mod;
+    if (fn == Py_None) {
+        Py_CLEAR(trace_clock_fn);
+    } else {
+        Py_INCREF(fn);
+        Py_XSETREF(trace_clock_fn, fn);
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+trace_ring_stats(PyObject *mod, PyObject *noargs)
+{
+    (void)mod;
+    (void)noargs;
+    return Py_BuildValue(
+        "{s:n,s:n,s:K,s:n}",
+        "capacity", trace_cap,
+        "pending", (Py_ssize_t)(trace_head - trace_tail),
+        "dropped", trace_dropped,
+        "highwater", trace_highwater);
+}
+
+/* Hand every undrained slot to Python as a list of
+   (code, serial, t, a, b, obj_or_None, flags) tuples, oldest first,
+   and reset the backlog (cumulative stats are kept).  Slot contents
+   are snapshotted into a plain buffer BEFORE any allocation so a GC
+   pass triggered mid-build cannot interleave new emits into the range
+   being read. */
+static PyObject *
+trace_ring_drain(PyObject *mod, PyObject *noargs)
+{
+    (void)mod;
+    (void)noargs;
+    Py_ssize_t n = (Py_ssize_t)(trace_head - trace_tail);
+    if (n == 0)
+        return PyList_New(0);
+    TraceSlot *tmp = PyMem_Malloc((size_t)n * sizeof(TraceSlot));
+    if (tmp == NULL)
+        return PyErr_NoMemory();
+    for (Py_ssize_t i = 0; i < n; i++) {
+        TraceSlot *s =
+            &trace_slots[(trace_tail + (uint64_t)i) % (uint64_t)trace_cap];
+        tmp[i] = *s;           /* steals s->ts_obj */
+        s->ts_obj = NULL;
+    }
+    trace_tail = trace_head;
+    PyObject *out = PyList_New(n);
+    if (out == NULL)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *obj = tmp[i].ts_obj ? tmp[i].ts_obj : Py_None;
+        PyObject *tup = Py_BuildValue(
+            "(IKdddOI)", tmp[i].ts_code,
+            (unsigned long long)tmp[i].ts_serial,
+            tmp[i].ts_t, tmp[i].ts_a, tmp[i].ts_b, obj, tmp[i].ts_flags);
+        Py_XDECREF(tmp[i].ts_obj);
+        tmp[i].ts_obj = NULL;
+        if (tup == NULL)
+            goto fail;
+        PyList_SET_ITEM(out, i, tup);
+    }
+    PyMem_Free(tmp);
+    return out;
+fail:
+    for (Py_ssize_t i = 0; i < n; i++)
+        Py_XDECREF(tmp[i].ts_obj);
+    PyMem_Free(tmp);
+    Py_XDECREF(out);
+    return NULL;
+}
+
+/* -- NativeTrace: the per-claim token -------------------------------- */
+/*                                                                     */
+/* One type covers both claim and DNS traces; it exposes the exact     */
+/* method surface of the pure ClaimTrace/DnsTrace so the ~15 existing  */
+/* `handle.ch_trace.X(...)` call sites work unchanged.  Each method    */
+/* reads the clock once and appends one ring slot.                     */
+
+typedef struct {
+    PyObject_HEAD
+    uint64_t nt_serial;
+    int nt_queries;
+} NTraceObject;
+
+static PyTypeObject NTrace_Type;
+
+/* Token shells are churned once per traced claim; a tiny freelist
+   skips the PyObject_New/PyObject_Free round trip.  Safe because the
+   shell is immutable plain C data (serial + query counter) fully
+   re-initialised on every pop. */
+#define NTRACE_FREE_CAP 64
+static NTraceObject *ntrace_free[NTRACE_FREE_CAP];
+static int ntrace_free_len = 0;
+
+static void
+NTrace_dealloc(NTraceObject *self)
+{
+    if (ntrace_free_len < NTRACE_FREE_CAP) {
+        ntrace_free[ntrace_free_len++] = self;
+        return;
+    }
+    PyObject_Free(self);
+}
+
+static NTraceObject *
+ntrace_new_token(void)
+{
+    NTraceObject *nt;
+    if (ntrace_free_len > 0) {
+        nt = ntrace_free[--ntrace_free_len];
+        _Py_NewReference((PyObject *)nt);
+    } else {
+        nt = PyObject_New(NTraceObject, &NTrace_Type);
+        if (nt == NULL)
+            return NULL;
+    }
+    nt->nt_serial = trace_serial_next++;
+    nt->nt_queries = 0;
+    return nt;
+}
+
+static PyObject *
+NTrace_codel_decision(NTraceObject *self, PyObject *args)
+{
+    PyObject *decision;
+    double sojourn, target;
+    if (!PyArg_ParseTuple(args, "Odd", &decision, &sojourn, &target))
+        return NULL;
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    Py_INCREF(decision);
+    trace_emit(self->nt_serial, TREV_CODEL, 0, now, sojourn, target,
+               decision);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+NTrace_slot_selected(NTraceObject *self, PyObject *source)
+{
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    Py_INCREF(source);
+    trace_emit(self->nt_serial, TREV_SLOT, 0, now, 0.0, 0.0, source);
+    Py_RETURN_NONE;
+}
+
+/* Mirrors ClaimTrace.claiming()'s getattr-guarded extraction: the
+   backend key and last-connect window are captured at record time
+   (they're mutable state of the serving slot); span assembly happens
+   at drain. */
+static PyObject *
+NTrace_claiming(NTraceObject *self, PyObject *slot)
+{
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    PyObject *backend = NULL;  /* str; NULL means '' */
+    PyObject *smgr = NULL;
+    double cstart = 0.0, cend = 0.0;
+    uint32_t flags = 0;
+
+    /* ConnectionSlotFSM.get_socket_mgr() just returns csf_smgr; read
+       the attribute directly to skip a Python frame per claim, and
+       fall back to the method for duck-typed slot fakes. */
+    smgr = PyObject_GetAttr(slot, str_csf_smgr);
+    if (smgr == NULL) {
+        if (!PyErr_ExceptionMatches(PyExc_AttributeError))
+            return NULL;
+        PyErr_Clear();
+        PyObject *get_smgr = PyObject_GetAttr(slot, str_get_socket_mgr);
+        if (get_smgr == NULL) {
+            if (!PyErr_ExceptionMatches(PyExc_AttributeError))
+                return NULL;
+            PyErr_Clear();
+        } else {
+            smgr = PyObject_CallNoArgs(get_smgr);
+            Py_DECREF(get_smgr);
+            if (smgr == NULL)
+                return NULL;
+        }
+    }
+    if (smgr != NULL && smgr != Py_None) {
+        PyObject *be = PyObject_GetAttr(smgr, str_sm_backend);
+        if (be == NULL) {
+            if (!PyErr_ExceptionMatches(PyExc_AttributeError))
+                goto fail;
+            PyErr_Clear();
+        } else {
+            int truthy = PyObject_IsTrue(be);
+            if (truthy < 0) {
+                Py_DECREF(be);
+                goto fail;
+            }
+            if (truthy) {
+                PyObject *keyv;
+                if (PyDict_Check(be)) {
+                    keyv = PyDict_GetItemWithError(be, str_key);
+                    Py_XINCREF(keyv);
+                    if (keyv == NULL && PyErr_Occurred()) {
+                        Py_DECREF(be);
+                        goto fail;
+                    }
+                } else {
+                    keyv = PyObject_CallMethodObjArgs(be, str_get,
+                                                      str_key, NULL);
+                    if (keyv == NULL) {
+                        Py_DECREF(be);
+                        goto fail;
+                    }
+                }
+                if (keyv != NULL && keyv != Py_None) {
+                    int kt = PyObject_IsTrue(keyv);
+                    if (kt < 0) {
+                        Py_DECREF(keyv);
+                        Py_DECREF(be);
+                        goto fail;
+                    }
+                    if (kt) {
+                        backend = PyObject_Str(keyv);
+                        if (backend == NULL) {
+                            Py_DECREF(keyv);
+                            Py_DECREF(be);
+                            goto fail;
+                        }
+                    }
+                }
+                Py_XDECREF(keyv);
+            }
+            Py_DECREF(be);
+        }
+        PyObject *last = PyObject_GetAttr(smgr, str_sm_last_connect);
+        if (last == NULL) {
+            if (!PyErr_ExceptionMatches(PyExc_AttributeError))
+                goto fail;
+            PyErr_Clear();
+        } else if (last == Py_None) {
+            Py_DECREF(last);
+        } else {
+            /* mirror `cstart, cend = last` */
+            PyObject *fast = PySequence_Fast(
+                last, "cannot unpack sm_last_connect");
+            Py_DECREF(last);
+            if (fast == NULL)
+                goto fail;
+            if (PySequence_Fast_GET_SIZE(fast) != 2) {
+                Py_DECREF(fast);
+                PyErr_SetString(PyExc_ValueError,
+                                "sm_last_connect is not a pair");
+                goto fail;
+            }
+            cstart = PyFloat_AsDouble(PySequence_Fast_GET_ITEM(fast, 0));
+            if (cstart == -1.0 && PyErr_Occurred()) {
+                Py_DECREF(fast);
+                goto fail;
+            }
+            cend = PyFloat_AsDouble(PySequence_Fast_GET_ITEM(fast, 1));
+            if (cend == -1.0 && PyErr_Occurred()) {
+                Py_DECREF(fast);
+                goto fail;
+            }
+            Py_DECREF(fast);
+            flags |= 1;
+        }
+    }
+    Py_XDECREF(smgr);
+    if (backend == NULL) {
+        Py_INCREF(str_empty);
+        backend = str_empty;
+    }
+    trace_emit(self->nt_serial, TREV_CLAIMING, flags, now, cstart, cend,
+               backend);
+    Py_RETURN_NONE;
+fail:
+    Py_XDECREF(smgr);
+    Py_XDECREF(backend);
+    return NULL;
+}
+
+static PyObject *
+NTrace_claimed(NTraceObject *self, PyObject *noargs)
+{
+    (void)noargs;
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    trace_emit(self->nt_serial, TREV_CLAIMED, 0, now, 0.0, 0.0, NULL);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+NTrace_requeued(NTraceObject *self, PyObject *noargs)
+{
+    (void)noargs;
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    trace_emit(self->nt_serial, TREV_REQUEUED, 0, now, 0.0, 0.0, NULL);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+NTrace_released(NTraceObject *self, PyObject *how)
+{
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    Py_INCREF(how);
+    trace_emit(self->nt_serial, TREV_RELEASED, 0, now, 0.0, 0.0, how);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+NTrace_failed(NTraceObject *self, PyObject *errobj)
+{
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    PyObject *name = NULL;
+    if (errobj != Py_None) {
+        name = PyObject_GetAttr((PyObject *)Py_TYPE(errobj),
+                                str_name_dunder);
+        if (name == NULL)
+            return NULL;
+    }
+    trace_emit(self->nt_serial, TREV_FAILED, 0, now, 0.0, 0.0, name);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+NTrace_cancelled(NTraceObject *self, PyObject *noargs)
+{
+    (void)noargs;
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    trace_emit(self->nt_serial, TREV_CANCELLED, 0, now, 0.0, 0.0, NULL);
+    Py_RETURN_NONE;
+}
+
+/* DnsTrace surface: query spans are identified by a small int token
+   (the pure class hands back a Span object; dns_client treats it as
+   opaque either way). */
+static PyObject *
+NTrace_query_begin(NTraceObject *self, PyObject *resolver)
+{
+    int tok = ++self->nt_queries;
+    if (trace_cap != 0) {
+        int err = 0;
+        double now = trace_now(&err);
+        if (err)
+            return NULL;
+        Py_INCREF(resolver);
+        trace_emit(self->nt_serial, TREV_DNS_QBEGIN, 0, now,
+                   (double)tok, 0.0, resolver);
+    }
+    return PyLong_FromLong(tok);
+}
+
+static PyObject *
+NTrace_query_end(NTraceObject *self, PyObject *args)
+{
+    PyObject *token, *outcome;
+    if (!PyArg_ParseTuple(args, "OO", &token, &outcome))
+        return NULL;
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    double tok = PyFloat_AsDouble(token);
+    if (tok == -1.0 && PyErr_Occurred())
+        return NULL;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    Py_INCREF(outcome);
+    trace_emit(self->nt_serial, TREV_DNS_QEND, 0, now, tok, 0.0,
+               outcome);
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+NTrace_done(NTraceObject *self, PyObject *args)
+{
+    PyObject *outcome, *errobj = Py_None;
+    if (!PyArg_ParseTuple(args, "O|O", &outcome, &errobj))
+        return NULL;
+    if (trace_cap == 0)
+        Py_RETURN_NONE;
+    int err = 0;
+    double now = trace_now(&err);
+    if (err)
+        return NULL;
+    PyObject *name = Py_None;
+    if (errobj != Py_None) {
+        name = PyObject_GetAttr((PyObject *)Py_TYPE(errobj),
+                                str_name_dunder);
+        if (name == NULL)
+            return NULL;
+    } else {
+        Py_INCREF(name);
+    }
+    PyObject *payload = PyTuple_Pack(2, outcome, name);
+    Py_DECREF(name);
+    if (payload == NULL)
+        return NULL;
+    trace_emit(self->nt_serial, TREV_DNS_DONE, 0, now, 0.0, 0.0,
+               payload);
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef NTrace_methods[] = {
+    {"codel_decision", (PyCFunction)NTrace_codel_decision, METH_VARARGS,
+     "Record a CoDel admission decision event."},
+    {"slot_selected", (PyCFunction)NTrace_slot_selected, METH_O,
+     "Record which queue served the claim."},
+    {"claiming", (PyCFunction)NTrace_claiming, METH_O,
+     "Queue wait over; capture the serving slot's backend/connect."},
+    {"claimed", (PyCFunction)NTrace_claimed, METH_NOARGS,
+     "Handshake done; the lease begins."},
+    {"requeued", (PyCFunction)NTrace_requeued, METH_NOARGS,
+     "Slot rejected the handshake; claim re-queued."},
+    {"released", (PyCFunction)NTrace_released, METH_O,
+     "Lease over (how='release'|'close')."},
+    {"failed", (PyCFunction)NTrace_failed, METH_O,
+     "Claim failed with the given error (or None)."},
+    {"cancelled", (PyCFunction)NTrace_cancelled, METH_NOARGS,
+     "Claim cancelled before being served."},
+    {"query_begin", (PyCFunction)NTrace_query_begin, METH_O,
+     "DNS query span opened; returns an opaque token."},
+    {"query_end", (PyCFunction)NTrace_query_end, METH_VARARGS,
+     "Close the DNS query span for the given token."},
+    {"done", (PyCFunction)NTrace_done, METH_VARARGS,
+     "DNS lookup finished (outcome[, err])."},
+    {NULL}
+};
+
+static PyTypeObject NTrace_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "cueball_tpu._cueball_native.NativeTrace",
+    .tp_basicsize = sizeof(NTraceObject),
+    .tp_dealloc = (destructor)NTrace_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "Hot-path trace token: every tracer method appends one "
+              "fixed-width slot to the native ring.",
+    .tp_methods = NTrace_methods,
+};
+
+static PyObject *
+trace_begin_common(PyObject *const *args, Py_ssize_t nargs,
+                   uint32_t code, const char *fname)
+{
+    if (nargs != 2) {
+        PyErr_Format(PyExc_TypeError,
+                     "%s expects (payload, start)", fname);
+        return NULL;
+    }
+    double start = PyFloat_AsDouble(args[1]);
+    if (start == -1.0 && PyErr_Occurred())
+        return NULL;
+    NTraceObject *nt = ntrace_new_token();
+    if (nt == NULL)
+        return NULL;
+    Py_INCREF(args[0]);
+    trace_emit(nt->nt_serial, code, 0, start, 0.0, 0.0, args[0]);
+    return (PyObject *)nt;
+}
+
+static PyObject *
+trace_claim_begin(PyObject *mod, PyObject *const *args, Py_ssize_t nargs)
+{
+    (void)mod;
+    return trace_begin_common(args, nargs, TREV_CLAIM_BEGIN,
+                              "trace_claim_begin");
+}
+
+static PyObject *
+trace_dns_begin(PyObject *mod, PyObject *const *args, Py_ssize_t nargs)
+{
+    (void)mod;
+    return trace_begin_common(args, nargs, TREV_DNS_BEGIN,
+                              "trace_dns_begin");
+}
+
+/* ------------------------------------------------------------------ */
+/* Claim-handle freelist                                               */
+/*                                                                     */
+/* CueBallClaimHandle allocation + re-init is a measured ~10% of the   */
+/* queued claim cycle (docs/claim-path-profile.md).  Terminal handles  */
+/* are load-bearing for the misuse traps, so recycling is gated on a   */
+/* refcount proof of sole ownership at POP time: a candidate leaves    */
+/* the freelist only when the freelist's own reference (plus, at most, */
+/* the terminal state handle's internal back-pointer cycle) is ALL     */
+/* that keeps it alive.  A handle the user still holds can never be    */
+/* handed out again — it just ages out of the array. */
+
+#define HANDLE_FREE_CAP 64
+static PyObject *handle_free[HANDLE_FREE_CAP];
+static int handle_free_head = 0;
+static int handle_free_len = 0;
+
+static PyObject *
+handle_free_push(PyObject *mod, PyObject *obj)
+{
+    (void)mod;
+    PyObject *evicted = NULL;
+    if (handle_free_len == HANDLE_FREE_CAP) {
+        evicted = handle_free[handle_free_head];
+        handle_free[handle_free_head] = NULL;
+        handle_free_head = (handle_free_head + 1) % HANDLE_FREE_CAP;
+        handle_free_len--;
+    }
+    int idx = (handle_free_head + handle_free_len) % HANDLE_FREE_CAP;
+    Py_INCREF(obj);
+    handle_free[idx] = obj;
+    handle_free_len++;
+    Py_XDECREF(evicted);  /* last: the dealloc can run arbitrary code */
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+handle_free_pop(PyObject *mod, PyObject *noargs)
+{
+    (void)mod;
+    (void)noargs;
+    for (int probe = 0; probe < 2 && handle_free_len > 0; probe++) {
+        PyObject *cand = handle_free[handle_free_head];
+        handle_free[handle_free_head] = NULL;
+        handle_free_head = (handle_free_head + 1) % HANDLE_FREE_CAP;
+        handle_free_len--;
+        int ok = 0;
+        if (Py_REFCNT(cand) == 1) {
+            ok = 1;
+        } else if (Py_REFCNT(cand) == 2) {
+            /* The one other reference must be the terminal state
+               handle's sh_fsm back-pointer, itself solely owned by the
+               candidate's __dict__ — then (freelist, handle, state
+               handle) form a closed system and nobody else can
+               observe the recycle. */
+            PyObject **dp = _PyObject_GetDictPtr(cand);
+            if (dp != NULL && *dp != NULL) {
+                PyObject *sh = PyDict_GetItemWithError(
+                    *dp, str_fsm_state_handle);
+                if (sh == NULL) {
+                    if (PyErr_Occurred())
+                        PyErr_Clear();
+                } else if (PyObject_TypeCheck(sh, &SHandle_Type) &&
+                           ((SHandleObject *)sh)->sh_fsm == cand &&
+                           Py_REFCNT(sh) == 1) {
+                    ok = 1;
+                }
+            }
+        }
+        if (ok)
+            return cand;  /* the freelist's reference moves to caller */
+        /* Externally held: rotate to the back so it ages out instead
+           of wedging the head. */
+        int idx = (handle_free_head + handle_free_len) % HANDLE_FREE_CAP;
+        handle_free[idx] = cand;
+        handle_free_len++;
+    }
+    Py_RETURN_NONE;
+}
+
+/* Total entries sitting in the engine run queue across loops (the
+   pump-queue-depth gauge on /metrics). */
+static PyObject *
+pump_depth(PyObject *mod, PyObject *noargs)
+{
+    (void)mod;
+    (void)noargs;
+    Py_ssize_t total = 0;
+    if (pump_map != NULL) {
+        PyObject *k, *v;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(pump_map, &pos, &k, &v))
+            if (PyList_Check(v))
+                total += PyList_GET_SIZE(v);
+    }
+    return PyLong_FromSsize_t(total);
+}
+
+/* ------------------------------------------------------------------ */
 /* module                                                              */
 
 static PyMethodDef native_methods[] = {
@@ -2173,6 +3002,29 @@ static PyMethodDef native_methods[] = {
      "Enable/disable pump coalescing; returns the previous setting."},
     {"pump_enabled", (PyCFunction)pump_enabled, METH_NOARGS,
      "Whether pump coalescing is currently enabled."},
+    {"pump_depth", (PyCFunction)pump_depth, METH_NOARGS,
+     "Entries currently queued in the engine run queue (all loops)."},
+    {"trace_ring_configure", (PyCFunction)trace_ring_configure, METH_O,
+     "Size (or, with 0, tear down) the native trace event ring."},
+    {"trace_set_clock", (PyCFunction)trace_set_clock, METH_O,
+     "Install a Python clock (utils.current_millis) for recorded "
+     "stamps, or None to read CLOCK_MONOTONIC directly."},
+    {"trace_ring_stats", (PyCFunction)trace_ring_stats, METH_NOARGS,
+     "Ring stats: {capacity, pending, dropped, highwater}."},
+    {"trace_ring_drain", (PyCFunction)trace_ring_drain, METH_NOARGS,
+     "Pop every recorded slot as (code, serial, t, a, b, obj, flags) "
+     "tuples, oldest first."},
+    {"trace_claim_begin", (PyCFunction)(void (*)(void))trace_claim_begin,
+     METH_FASTCALL,
+     "trace_claim_begin(payload, start_ms) -> NativeTrace token."},
+    {"trace_dns_begin", (PyCFunction)(void (*)(void))trace_dns_begin,
+     METH_FASTCALL,
+     "trace_dns_begin(payload, start_ms) -> NativeTrace token."},
+    {"handle_free_push", (PyCFunction)handle_free_push, METH_O,
+     "Stash a terminal claim handle for recycling."},
+    {"handle_free_pop", (PyCFunction)handle_free_pop, METH_NOARGS,
+     "Pop a recyclable claim handle, or None (refcount-guarded: "
+     "handles the user still holds are never handed out)."},
     {NULL}
 };
 
@@ -2246,14 +3098,28 @@ PyInit__cueball_native(void)
         (str_run_transition =
             PyUnicode_InternFromString("_run_transition")) == NULL ||
         (str_pump_deferral =
-            PyUnicode_InternFromString("cueball runq deferral")) == NULL)
+            PyUnicode_InternFromString("cueball runq deferral")) == NULL ||
+        (str_get_socket_mgr =
+            PyUnicode_InternFromString("get_socket_mgr")) == NULL ||
+        (str_csf_smgr =
+            PyUnicode_InternFromString("csf_smgr")) == NULL ||
+        (str_sm_backend =
+            PyUnicode_InternFromString("sm_backend")) == NULL ||
+        (str_sm_last_connect =
+            PyUnicode_InternFromString("sm_last_connect")) == NULL ||
+        (str_key = PyUnicode_InternFromString("key")) == NULL ||
+        (str_get = PyUnicode_InternFromString("get")) == NULL ||
+        (str_name_dunder =
+            PyUnicode_InternFromString("__name__")) == NULL ||
+        (str_empty = PyUnicode_InternFromString("")) == NULL)
         return NULL;
 
     if (PyType_Ready(&Emitter_Type) < 0 ||
         PyType_Ready(&Once_Type) < 0 ||
         PyType_Ready(&Gate_Type) < 0 ||
         PyType_Ready(&GotoGate_Type) < 0 ||
-        PyType_Ready(&SHandle_Type) < 0)
+        PyType_Ready(&SHandle_Type) < 0 ||
+        PyType_Ready(&NTrace_Type) < 0)
         return NULL;
 
     /* The base `on` descriptor: emitter_internal_on_fast compares
@@ -2312,6 +3178,13 @@ PyInit__cueball_native(void)
     if (PyModule_AddObject(m, "StateHandleBase",
                            (PyObject *)&SHandle_Type) < 0) {
         Py_DECREF(&SHandle_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&NTrace_Type);
+    if (PyModule_AddObject(m, "NativeTrace",
+                           (PyObject *)&NTrace_Type) < 0) {
+        Py_DECREF(&NTrace_Type);
         Py_DECREF(m);
         return NULL;
     }

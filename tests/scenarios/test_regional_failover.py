@@ -19,6 +19,7 @@ import asyncio
 import pytest
 
 from cueball_tpu import netsim
+from cueball_tpu import trace as mod_trace
 
 import scenario_common as sco
 
@@ -30,6 +31,10 @@ def test_regional_failover_recovery_envelope(seed):
     result = {}
 
     async def main():
+        # Full-rate tracing rides along (the native recorder under
+        # virtual time when the C engine is loaded), so the recovery
+        # envelope below can be re-derived from span timestamps alone.
+        mod_trace.enable_tracing(ring_size=1024, sample_rate=1.0)
         backends = sco.region_backends(regions=3, per_region=3)
         pool, res = sco.make_sim_pool(fabric, backends, spares=3,
                                       maximum=9)
@@ -69,9 +74,15 @@ def test_regional_failover_recovery_envelope(seed):
             await asyncio.sleep(0.5)
         result['dead_after_heal'] = sorted(pool.p_dead)
         result['healed_at_s'] = loop.time()
+        result['claim_traces'] = [
+            t for t in mod_trace.trace_ring()
+            if t.root.name == 'claim' and t.root.end is not None]
         await sco.stop_pool(pool, res)
 
-    sc.run(lambda: main())
+    try:
+        sc.run(lambda: main())
+    finally:
+        mod_trace.disable_tracing()
 
     # Envelopes. Recovery: one failed claim consumes at most its
     # 1000ms claim timeout; with 2 healthy regions the pool's spare
@@ -86,3 +97,28 @@ def test_regional_failover_recovery_envelope(seed):
     # the scenario exercised real machines end to end.
     assert [l for _, l in sc.fired] == ['partition-r1', 'heal-r1']
     assert len(sc.trace) > 100
+
+    # Trace envelope: the recovery bound must be re-derivable from the
+    # span record alone. Root starts/ends are virtual-clock millis, so
+    # the partition instant is t=5000ms; recovery is the end of the
+    # third consecutive successful claim begun after it.
+    claims = sorted(result['claim_traces'], key=lambda t: t.root.start)
+    assert claims, 'tracing recorded no completed claim traces'
+    assert all(t.spans[1].name == 'queue_wait' for t in claims), \
+        'claim trace missing its queue_wait span'
+    post = [t for t in claims if t.root.start >= 5000.0]
+    assert post, 'no claim traces recorded after the partition'
+    streak, recovered_at = 0, None
+    for t in post:
+        if t.root.attrs.get('outcome') in ('released', 'closed'):
+            streak += 1
+            if streak == 3:
+                recovered_at = t.root.end
+                break
+        else:
+            streak = 0
+    assert recovered_at is not None, \
+        'spans never show 3 consecutive post-partition successes'
+    result['recovery_from_spans_s'] = (recovered_at - 5000.0) / 1000.0
+    assert result['recovery_from_spans_s'] < 2.5, result[
+        'recovery_from_spans_s']

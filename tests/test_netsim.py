@@ -271,6 +271,36 @@ def test_scenario_failure_dump_and_replay_hint(tmp_path, monkeypatch):
     assert 'pytest' in dump['replay']
 
 
+def test_scenario_failure_dump_embeds_slowest_traces(tmp_path, monkeypatch):
+    """When tracing is live, a failure dump carries the slowest
+    completed traces (full span lists) and the tracer summary, so an
+    envelope breach shows where the slow claims spent their time."""
+    from cueball_tpu import trace as mod_trace
+    monkeypatch.setenv(netsim.scenario.DUMP_DIR_ENV, str(tmp_path))
+    sc = netsim.Scenario('doomed-traced', seed=29)
+
+    async def main():
+        mod_trace.enable_tracing(ring_size=16, sample_rate=1.0)
+        tr = mod_trace.ClaimTrace(mod_trace._runtime, None)
+        await asyncio.sleep(0.5)
+        tr.released('release')
+        raise AssertionError('envelope blown')
+
+    try:
+        with pytest.raises(AssertionError):
+            sc.run(lambda: main())
+    finally:
+        mod_trace.disable_tracing()
+    import json
+    dump = json.loads(
+        (tmp_path / 'doomed-traced-seed29.json').read_text())
+    assert dump['trace_summary']['enabled'] is True
+    [spans] = dump['slowest_traces']
+    assert spans[0]['name'] == 'claim'
+    assert spans[0]['attrs']['outcome'] == 'released'
+    assert spans[0]['end'] - spans[0]['start'] == pytest.approx(500.0)
+
+
 def test_herd_statistics_helpers():
     outcomes = [
         {'cohort': 'a', 'ok': True}, {'cohort': 'a', 'ok': True},
