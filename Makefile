@@ -4,8 +4,8 @@ PYTHON ?= python3
 LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
-.PHONY: test check bench bench-host dryrun coverage native ci docs \
-	docs-check fsm-graph scenarios scenarios-fast
+.PHONY: test check bench bench-host bench-sharded dryrun coverage \
+	native ci docs docs-check fsm-graph scenarios scenarios-fast
 
 native:
 	$(PYTHON) native/build.py
@@ -58,6 +58,12 @@ bench:
 # timeout. Emits the same single JSON line with host_only=true.
 bench-host:
 	$(PYTHON) bench.py --host-only
+
+# The shard-router scaling sweep only (docs/sharding.md): K=1,2,4,8
+# spawn-backend shards, aggregate claim throughput per K, and the
+# core-normalized linear_fraction. Emits one compact JSON object.
+bench-sharded:
+	$(PYTHON) bench.py --sharded-only
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

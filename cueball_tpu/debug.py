@@ -83,7 +83,10 @@ def dump_fsm_histories(stream=None) -> str:
             if k != 'schedule'))
 
     for uuid, pool in list(pool_monitor.pm_pools.items()):
-        buf.write('pool %s domain=%s\n' % (uuid, pool.p_domain))
+        shard = getattr(pool, 'p_shard', None)
+        buf.write('pool %s domain=%s%s\n' % (
+            uuid, pool.p_domain,
+            '' if shard is None else ' shard=%d' % shard))
         buf.write(_fsm_line('(pool)', pool))
         for key, slots in list(pool.p_connections.items()):
             for slot in slots:
@@ -106,6 +109,17 @@ def dump_fsm_histories(stream=None) -> str:
     for uuid, res in list(pool_monitor.pm_dns_res.items()):
         buf.write('dns_res %s domain=%s\n' % (uuid, res.r_domain))
         buf.write(_fsm_line('(resolver)', res))
+
+    # Started FleetRouters (if the shard package is in play): shard FSM
+    # states and the pool -> shard ownership map, so one SIGUSR2 answers
+    # "which shard owns the wedged pool" too.
+    for router in mod_trace._active_fleet_routers():
+        buf.write('fleet_router backend=%s shards=%d\n' % (
+            router.fr_backend, router.fr_nshards))
+        for sid, fsm in sorted(router.fr_fsms.items()):
+            buf.write(_fsm_line('shard %d' % sid, fsm))
+        for name, rec in sorted(router.fr_pools.items()):
+            buf.write('  pool %-24s -> shard %d\n' % (name, rec.shard_id))
 
     # When claim tracing is on, the slowest recent claims land next to
     # the FSM states: a wedged process's dump answers both "what state

@@ -130,3 +130,20 @@ class ConnectionClosedError(CueBallError):
             'Connection closed unexpectedly to backend %s (%s:%s)' % (
                 backend.get('name') or backend.get('key'),
                 backend.get('address'), backend.get('port')))
+
+
+class ShardDeadError(CueBallError):
+    """A FleetRouter call was routed to a shard whose event loop is no
+    longer running (loop stopped, thread exited, or child process
+    died). Claims and submits against pools owned by that shard fail
+    fast with this error instead of deadlocking on a loop that will
+    never pump; the router re-homes the pools when the shard is
+    restarted."""
+
+    def __init__(self, shard_id: int, detail: str = '',
+                 cause: 'BaseException | None' = None):
+        self.shard_id = shard_id
+        msg = 'Shard %r event loop is not running' % (shard_id,)
+        if detail:
+            msg += ' (%s)' % detail
+        super().__init__(msg, cause)

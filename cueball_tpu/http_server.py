@@ -16,6 +16,7 @@ from PoolMonitor.to_kang_options().
     GET /kang/objects/<type>    - ids of registered objects of a type
     GET /kang/obj/<type>/<id>   - one object's snapshot
     GET /kang/fleet             - attached FleetSampler's batched decisions
+    GET /kang/shards            - started FleetRouters' shard snapshots
     GET /kang/traces            - claim/DNS trace ring as NDJSON spans
     GET /metrics                - prometheus text metrics (collector)
 """
@@ -145,6 +146,11 @@ def _route(method: str, path: str, collector):
         elif path == '/kang/fleet':
             body = json.dumps(pool_monitor.fleet_snapshot(),
                               default=_json_default).encode()
+        elif path == '/kang/shards':
+            body = json.dumps(
+                {'routers': [r.snapshot()
+                             for r in mod_trace._active_fleet_routers()]},
+                default=_json_default).encode()
         elif path == '/kang/traces':
             # Completed claim/DNS traces, one OTLP-field-named span per
             # line (see trace.py). Empty body when tracing is off.

@@ -231,3 +231,61 @@ class Collector:
 
 def create_collector(labels: dict | None = None) -> Collector:
     return Collector(labels)
+
+
+def merge_expositions(texts) -> str:
+    """Merge several exposition-format payloads into one.
+
+    The spawn shard backend gives every child process its own
+    collector; a fleet-wide /metrics scrape gathers each child's
+    ``collect()`` text and merges here. Sample lines concatenate
+    grouped under one ``# HELP``/``# TYPE`` header pair per metric
+    family (repeating a family header mid-payload is a spec
+    violation); the first payload to declare a family wins its header.
+    Sample rows are kept verbatim and in arrival order — children are
+    expected to disambiguate with a ``shard`` label, exactly like the
+    thread backend's shard-labelled gauges on a shared collector.
+    """
+    families: dict[str, dict] = {}
+    order: list[str] = []
+    for text in texts:
+        if not text:
+            continue
+        current = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith('# HELP ') or line.startswith('# TYPE '):
+                _, kind, name_rest = line.split(' ', 2)
+                name, _, rest = name_rest.partition(' ')
+                fam = families.get(name)
+                if fam is None:
+                    fam = {'help': None, 'type': None, 'samples': []}
+                    families[name] = fam
+                    order.append(name)
+                if kind == 'HELP' and fam['help'] is None:
+                    fam['help'] = rest
+                elif kind == 'TYPE' and fam['type'] is None:
+                    fam['type'] = rest
+                current = name
+                continue
+            # A sample line; histogram rows (name_bucket/_sum/_count)
+            # belong to the family whose headers precede them.
+            if current is None:
+                name = line.split('{', 1)[0].split(' ', 1)[0]
+                fam = families.setdefault(
+                    name, {'help': None, 'type': None, 'samples': []})
+                if name not in order:
+                    order.append(name)
+                fam['samples'].append(line)
+            else:
+                families[current]['samples'].append(line)
+    out = []
+    for name in order:
+        fam = families[name]
+        if fam['help'] is not None:
+            out.append('# HELP %s %s' % (name, fam['help']))
+        if fam['type'] is not None:
+            out.append('# TYPE %s %s' % (name, fam['type']))
+        out.extend(fam['samples'])
+    return '\n'.join(out) + '\n' if out else ''

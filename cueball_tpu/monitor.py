@@ -127,6 +127,11 @@ class PoolMonitor:
             obj['last_rebalance'] = round(pool.p_last_rebalance)
         obj['resolvers'] = getattr(pool.p_resolver, 'r_resolvers', None)
         obj['state'] = pool.get_state()
+        shard = getattr(pool, 'p_shard', None)
+        if shard is not None:
+            # Stamped by a FleetRouter at pool construction; plain
+            # (unsharded) pools keep their historical snapshot shape.
+            obj['shard'] = shard
         obj['counters'] = pool.p_counters
         inner = getattr(pool.p_resolver, 'r_fsm', pool.p_resolver)
         obj['options'] = {
@@ -222,6 +227,12 @@ class PoolMonitor:
         if self.pm_fleet is not None:
             out['fleet'] = self.fleet_snapshot()
         from . import trace as mod_trace
+        routers = mod_trace._active_fleet_routers()
+        if routers:
+            # Started FleetRouters: backend, per-shard FSM states and
+            # the pool -> shard ownership map, merged into the one
+            # fleet-wide snapshot.
+            out['shards'] = [r.snapshot() for r in routers]
         if mod_trace.tracing_enabled():
             # Ring occupancy + sampling counters (the spans themselves
             # are served raw by GET /kang/traces).

@@ -197,11 +197,17 @@ class FleetSampler:
       surface reports the mesh shape.
     - meshAxes: mesh axis name(s) the pools axis shards over
       (default ('pools',); pass ('host', 'chip') for a 2-D mesh).
+    - shard: a shard id. When given, the sampler only samples pools
+      whose ``p_shard`` matches (the FleetRouter stamps one per owned
+      pool) and the published ``cueball_fleet_*`` gauges carry a
+      ``shard`` label. One such sampler runs per shard loop; the
+      router reduces their fleet rows with :func:`reduce_fleet`.
     """
 
     def __init__(self, options: dict | None = None):
         options = options or {}
         self.fs_monitor = options.get('monitor') or default_monitor
+        self.fs_shard = options.get('shard')
         self.fs_interval = options.get('interval') or SAMPLER_INT
         self.fs_taps = options.get('taps') or 128
         self.fs_capacity = options.get('capacity') or 8
@@ -525,7 +531,15 @@ class FleetSampler:
         monitor = self.fs_monitor
         gen = getattr(monitor, 'pm_generation', None)
         if gen is None or gen != self.fs_monitor_gen:
-            self._assign_rows(monitor.pm_pools)
+            pools = monitor.pm_pools
+            if self.fs_shard is not None:
+                # Shard-scoped sampler: only this shard's pools. The
+                # router stamps p_shard at pool construction, which
+                # happens-before any tick of this sampler on the same
+                # shard loop.
+                pools = {u: p for u, p in pools.items()
+                         if getattr(p, 'p_shard', None) == self.fs_shard}
+            self._assign_rows(pools)
             self.fs_monitor_gen = gen
         abs_now = mod_utils.current_millis()
         now = abs_now - self.fs_epoch
@@ -621,9 +635,12 @@ class FleetSampler:
         if collector is None:
             collector = mod_trace.active_collector()
         if collector is not None:
+            labels = ({'shard': str(self.fs_shard)}
+                      if self.fs_shard is not None else None)
             for name, help_ in _FLEET_GAUGES.items():
                 collector.gauge(
-                    'cueball_fleet_' + name, help_).set(fleet_np[name])
+                    'cueball_fleet_' + name, help_).set(
+                        fleet_np[name], labels)
         return record
 
     # -- kang integration ------------------------------------------------
@@ -646,6 +663,7 @@ class FleetSampler:
             latest['pools'] = dict(latest['pools'])
         return {
             'interval_ms': self.fs_interval,
+            'shard': self.fs_shard,
             'capacity': self.fs_capacity,
             'ticks': self.fs_ticks,
             'rows': dict(self.fs_rows),
@@ -655,3 +673,65 @@ class FleetSampler:
             'last_tick_visits': self.fs_tick_visits,
             'latest': latest,
         }
+
+
+def reduce_fleet(records, mesh=None, mesh_axes=('host', 'chip')):
+    """Reduce per-shard fleet aggregate rows into one fleet-wide row.
+
+    ``records`` is a list of shard samplers' ``record['fleet']`` dicts
+    (the :data:`_FLEET_GAUGES` keys). ``n_pools`` sums; the mean and
+    fraction fields combine weighted by each shard's pool count;
+    ``max_sojourn`` takes the worst shard. Shards with zero pools
+    contribute nothing to the weighted fields.
+
+    With a ``mesh``, the per-shard columns are placed sharded over the
+    flattened ``mesh_axes`` (the same 2-D ('host', 'chip') layout the
+    sharded telemetry step uses) and the reductions compile to
+    all-reduces over ICI — the shard -> host -> mesh reduce tree. The
+    shard axis pads to a multiple of the mesh size with zero-weight
+    rows.
+    """
+    import numpy as np
+    names = list(_FLEET_GAUGES)
+    records = [r for r in records if r]
+    if not records:
+        return {name: 0.0 for name in names}
+    cols = {name: np.asarray([float(r.get(name, 0.0)) for r in records],
+                             np.float32)
+            for name in names}
+    if mesh is None:
+        w = cols['n_pools']
+        tot = float(w.sum())
+        safe = tot if tot > 0.0 else 1.0
+        out = {}
+        for name in names:
+            if name == 'n_pools':
+                out[name] = tot
+            elif name == 'max_sojourn':
+                out[name] = float(cols[name].max())
+            else:
+                out[name] = float((cols[name] * w).sum() / safe)
+        return out
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+    pad = (-len(records)) % int(mesh.size)
+    if pad:
+        cols = {name: np.pad(col, (0, pad))
+                for name, col in cols.items()}
+    sharding = NamedSharding(mesh, PartitionSpec(tuple(mesh_axes)))
+    dev = {name: jax.device_put(col, sharding)
+           for name, col in cols.items()}
+    w = dev['n_pools']
+    tot = jnp.sum(w)
+    safe = jnp.where(tot > 0.0, tot, 1.0)
+    out = {}
+    for name in names:
+        if name == 'n_pools':
+            out[name] = float(tot)
+        elif name == 'max_sojourn':
+            out[name] = float(jnp.max(dev[name]))
+        else:
+            out[name] = float(jnp.sum(dev[name] * w) / safe)
+    return out

@@ -1,0 +1,26 @@
+"""Shard-per-core fleet scale-out: K event-loop shards behind a
+consistent-hash claim router.
+
+The single-loop engine tops out on asyncio tick cost, not pool
+bookkeeping (see docs/claim-path-profile.md round 7). This package
+scales out instead of up: a :class:`FleetRouter` fronts K worker
+shards — each with its own asyncio loop, runq pump and trace context —
+owning disjoint sets of ConnectionPools assigned by a consistent-hash
+ring on the pool key. Claims never cross a loop boundary on the hot
+path; cross-shard traffic happens only at pool create/destroy and at
+telemetry/export time. See docs/sharding.md.
+"""
+
+from .ring import HashRing
+from .router import (FleetRouter, RoutedClaim, active_routers)
+from .worker import ShardFSM
+from ..errors import ShardDeadError
+
+__all__ = [
+    'HashRing',
+    'FleetRouter',
+    'RoutedClaim',
+    'ShardFSM',
+    'ShardDeadError',
+    'active_routers',
+]

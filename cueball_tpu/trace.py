@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import collections
 import json
+import threading
 
 from . import runq as mod_runq
 from . import utils as mod_utils
@@ -111,6 +112,53 @@ RING_GAUGES = {
     'cueball_pump_queue_depth':
         'Callbacks waiting in the engine run-queue pump',
 }
+
+
+# -- shard identity ---------------------------------------------------------
+# Each FleetRouter shard thread/process stamps its id here at bootstrap;
+# spans record it so the merged export surfaces keep a per-shard
+# breakdown. Thread-local because thread-backend shards share this
+# module; the native recorder mirrors it into a C thread-local so slots
+# written without any Python payload still carry the shard (flags bits
+# 8+, biased by +1 so 0 keeps meaning "no shard").
+
+_SHARD_TLS = threading.local()
+_SHARD_FROM_TLS = object()  # sentinel: "read the caller's TLS"
+_SHARD_FLAG_SHIFT = 8
+
+
+def set_shard_id(shard_id: int | None) -> None:
+    """Tag the calling thread (and, through the C TLS, every native
+    trace slot it writes) with a FleetRouter shard id. None clears."""
+    _SHARD_TLS.shard = None if shard_id is None else int(shard_id)
+    if _NATIVE_TRACE_OK and hasattr(_native, 'trace_set_shard'):
+        _native.trace_set_shard(-1 if shard_id is None else int(shard_id))
+
+
+def get_shard_id() -> int | None:
+    return getattr(_SHARD_TLS, 'shard', None)
+
+
+def _shard_from_flags(flags: int) -> int | None:
+    sid = (int(flags) >> _SHARD_FLAG_SHIFT) - 1
+    return sid if sid >= 0 else None
+
+
+# External NDJSON producers merged into export_ndjson() — the seam the
+# FleetRouter's spawn backend uses to fold child-process trace rings
+# into the parent's /kang/traces view. Each source is a zero-arg
+# callable returning an NDJSON string ('' when it has nothing).
+_EXPORT_SOURCES: tuple = ()
+
+
+def add_export_source(fn) -> None:
+    global _EXPORT_SOURCES
+    _EXPORT_SOURCES = _EXPORT_SOURCES + (fn,)
+
+
+def remove_export_source(fn) -> None:
+    global _EXPORT_SOURCES
+    _EXPORT_SOURCES = tuple(f for f in _EXPORT_SOURCES if f is not fn)
 
 
 def _new_trace_id() -> str:
@@ -265,19 +313,32 @@ class ClaimTrace(Trace):
                  ident: tuple | None = None):
         # 'pool' may be a ConnectionPool or a ConnectionSet standing in
         # as one (cset claims hand the set itself down), so everything
-        # here is getattr-guarded. Replay passes the (pool, domain)
-        # identity captured at emit time instead of the live object.
+        # here is getattr-guarded. Replay passes the (pool, domain[,
+        # shard]) identity captured at emit time instead of the live
+        # object. Pools owned by a FleetRouter shard carry p_shard and
+        # stamp it on the span; plain pools produce the exact
+        # pre-sharding attrs (no 'shard' key), keeping unsharded
+        # exports byte-identical.
         if ident is None:
             uuid = getattr(pool, 'p_uuid', None) or \
                 getattr(pool, 'cs_uuid', None) or ''
             domain = getattr(pool, 'p_domain', None) or \
                 getattr(pool, 'cs_domain', None) or ''
+            shard = getattr(pool, 'p_shard', None)
+            if shard is None:
+                shard = getattr(pool, 'cs_shard', None)
             ident = (str(uuid), str(domain))
-        Trace.__init__(self, runtime, {
+            if shard is not None:
+                ident += (int(shard),)
+        attrs = {
             'kind': 'claim',
             'pool': ident[0],
             'domain': ident[1],
-        }, start=start, trace_id_int=trace_id_int)
+        }
+        if len(ident) > 2 and ident[2] is not None:
+            attrs['shard'] = ident[2]
+        Trace.__init__(self, runtime, attrs,
+                       start=start, trace_id_int=trace_id_int)
         self.ct_queue_span = self.begin_span('queue_wait',
                                              start=self.root.start)
         self.ct_handshake_span = None
@@ -382,12 +443,24 @@ class DnsTrace(Trace):
 
     def __init__(self, runtime: '_TraceRuntime', domain: str, rtype: str,
                  start: float | None = None,
-                 trace_id_int: int | None = None):
-        Trace.__init__(self, runtime, {
+                 trace_id_int: int | None = None,
+                 shard=_SHARD_FROM_TLS):
+        # Live construction reads the caller's shard id off the thread
+        # local (a DNS lookup has no pool to carry it); native replay
+        # passes the shard decoded from the slot's flags explicitly —
+        # including None — so the drain thread's own TLS never leaks
+        # into replayed traces.
+        if shard is _SHARD_FROM_TLS:
+            shard = get_shard_id()
+        attrs = {
             'kind': 'dns',
             'domain': str(domain),
             'type': str(rtype),
-        }, start=start, trace_id_int=trace_id_int)
+        }
+        if shard is not None:
+            attrs['shard'] = int(shard)
+        Trace.__init__(self, runtime, attrs,
+                       start=start, trace_id_int=trace_id_int)
 
     def query_begin(self, resolver: str,
                     now: float | None = None) -> Span:
@@ -514,13 +587,22 @@ class _TraceRuntime:
             handle.ch_trace = ClaimTrace(self, pool, start=start)
 
     def _claim_ident(self, pool) -> tuple:
-        """(pool uuid, domain) as strings, cached on the pool so the
-        native fast path pays one attribute load instead of four."""
+        """(pool uuid, domain[, shard]) as strings (shard an int),
+        cached on the pool so the native fast path pays one attribute
+        load instead of four. Shard-owned pools (FleetRouter sets
+        p_shard right after construction, before any claim) get the
+        3-tuple; plain pools keep the 2-tuple so their exports are
+        bit-for-bit what they were before sharding existed."""
         uuid = getattr(pool, 'p_uuid', None) or \
             getattr(pool, 'cs_uuid', None) or ''
         domain = getattr(pool, 'p_domain', None) or \
             getattr(pool, 'cs_domain', None) or ''
         ident = (str(uuid), str(domain))
+        shard = getattr(pool, 'p_shard', None)
+        if shard is None:
+            shard = getattr(pool, 'cs_shard', None)
+        if shard is not None:
+            ident += (int(shard),)
         try:
             pool._tr_claim_ident = ident
         except (AttributeError, TypeError):
@@ -609,7 +691,8 @@ class _TraceRuntime:
                 tid, domain, rtype = obj
                 pending[serial] = [
                     DnsTrace(self, domain, rtype, start=t,
-                             trace_id_int=tid),
+                             trace_id_int=tid,
+                             shard=_shard_from_flags(flags)),
                     None,
                 ]
             else:
@@ -704,10 +787,14 @@ class _TraceRuntime:
                     continue
                 if getattr(pool, 'telemetry_attach', None) is None:
                     continue
-                row = _GaugeRow(pool, {
+                labels = {
                     'pool': str(uuid),
                     'domain': str(getattr(pool, 'p_domain', '')),
-                })
+                }
+                shard = getattr(pool, 'p_shard', None)
+                if shard is not None:
+                    labels['shard'] = str(shard)
+                row = _GaugeRow(pool, labels)
                 self.tr_rows[uuid] = row
                 pool.telemetry_attach(row)
         for row in self.tr_rows.values():
@@ -823,15 +910,24 @@ def trace_ring() -> list:
 
 def export_ndjson() -> str:
     """All ring spans as NDJSON, one span per line, oldest trace first
-    (the /kang/traces payload). Empty string when tracing is off."""
+    (the /kang/traces payload), followed by any registered external
+    sources (child-process shard rings). Empty string when tracing is
+    off and no source has anything."""
     runtime = _runtime
-    if runtime is None:
-        return ''
-    runtime._drain_native()
     lines: list = []
-    for trace in runtime.tr_ring:
-        lines.extend(trace.ndjson_lines())
-    return '\n'.join(lines) + '\n' if lines else ''
+    if runtime is not None:
+        runtime._drain_native()
+        for trace in runtime.tr_ring:
+            lines.extend(trace.ndjson_lines())
+    out = '\n'.join(lines) + '\n' if lines else ''
+    for fn in _EXPORT_SOURCES:
+        try:
+            extra = fn()
+        except Exception:
+            extra = ''
+        if extra:
+            out += extra if extra.endswith('\n') else extra + '\n'
+    return out
 
 
 # Identity of the current netsim scenario run (seed, name, schedule),
@@ -869,9 +965,22 @@ def summary() -> dict:
         }
         if runtime.tr_native:
             out['native_ring'] = dict(_native.trace_ring_stats())
+    routers = _active_fleet_routers()
+    if routers:
+        out['shards'] = [r.snapshot() for r in routers]
     if _run_metadata:
         out['run'] = dict(_run_metadata)
     return out
+
+
+def _active_fleet_routers() -> list:
+    """Started FleetRouters, without importing the shard package until
+    one could actually exist (it registers on start)."""
+    import sys
+    mod = sys.modules.get('cueball_tpu.shard.router')
+    if mod is None:
+        return []
+    return mod.active_routers()
 
 
 def dump_traces(limit: int = 8) -> str:

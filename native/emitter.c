@@ -2272,6 +2272,24 @@ static uint64_t trace_serial_next = 1; /* NEVER reset: stale tokens  */
                                        /* must not alias new traces  */
 static PyObject *trace_clock_fn = NULL;
 
+/* FleetRouter shard id of the emitting thread, stamped into every
+   slot's flags at bits 8+ biased by +1 (0 keeps meaning "no shard";
+   bit 0 stays the TREV_CLAIMING has-connect flag). Thread-local
+   because thread-backend shards share this one ring — the GIL already
+   serializes trace_emit, the TLS only records identity. Spawn-backend
+   children each get their own ring and set their own value. */
+static _Thread_local int trace_tls_shard = -1;
+
+#define TRACE_SHARD_FLAG_SHIFT 8
+
+static inline uint32_t
+trace_shard_flags(void)
+{
+    return trace_tls_shard < 0
+        ? 0u
+        : ((uint32_t)(trace_tls_shard + 1)) << TRACE_SHARD_FLAG_SHIFT;
+}
+
 static PyObject *str_get_socket_mgr;
 static PyObject *str_csf_smgr;
 static PyObject *str_sm_backend;
@@ -2329,7 +2347,7 @@ trace_emit(uint64_t serial, uint32_t code, uint32_t flags,
     }
     TraceSlot *s = &trace_slots[trace_head % (uint64_t)trace_cap];
     s->ts_code = code;
-    s->ts_flags = flags;
+    s->ts_flags = flags | trace_shard_flags();
     s->ts_serial = serial;
     s->ts_t = t;
     s->ts_a = a;
@@ -2882,6 +2900,17 @@ trace_dns_begin(PyObject *mod, PyObject *const *args, Py_ssize_t nargs)
                               "trace_dns_begin");
 }
 
+static PyObject *
+trace_set_shard(PyObject *mod, PyObject *arg)
+{
+    (void)mod;
+    long sid = PyLong_AsLong(arg);
+    if (sid == -1 && PyErr_Occurred())
+        return NULL;
+    trace_tls_shard = sid < 0 ? -1 : (int)sid;
+    Py_RETURN_NONE;
+}
+
 /* ------------------------------------------------------------------ */
 /* Claim-handle freelist                                               */
 /*                                                                     */
@@ -3020,6 +3049,9 @@ static PyMethodDef native_methods[] = {
     {"trace_dns_begin", (PyCFunction)(void (*)(void))trace_dns_begin,
      METH_FASTCALL,
      "trace_dns_begin(payload, start_ms) -> NativeTrace token."},
+    {"trace_set_shard", (PyCFunction)trace_set_shard, METH_O,
+     "trace_set_shard(shard_id): stamp this thread's trace slots with "
+     "a FleetRouter shard id (bits 8+ of flags, +1 biased; -1 clears)."},
     {"handle_free_push", (PyCFunction)handle_free_push, METH_O,
      "Stash a terminal claim handle for recycling."},
     {"handle_free_pop", (PyCFunction)handle_free_pop, METH_NOARGS,

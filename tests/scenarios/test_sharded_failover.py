@@ -1,0 +1,215 @@
+"""Scenario: sharded-vs-plain byte parity through regional failover.
+
+The shard router's core promise is that routing adds NO policy: a
+claim routed through an inline-backend ``FleetRouter`` is a dict
+lookup plus a direct ``pool.claim_cb`` call on the same loop. This
+scenario proves it the strong way — run the SAME seeded hostile
+schedule (region 1 partitions at t=5s, heals at t=25s, then a CoDel
+overload burst) twice, once against a plain pool and once against the
+identical pool owned by shard of a K=4 router, and assert:
+
+- the FSM transition traces are IDENTICAL once the router's own
+  ``ShardFSM`` entries are filtered out (the router adds lifecycle
+  machines, never pool behavior);
+- the CoDel shed counters are equal AND nonzero (the overload burst
+  actually bit, and bit identically);
+- the recovery envelope matches between arms.
+
+Both arms anchor pool creation at the same virtual instant so every
+pool-side timer shares one epoch; from there the runs must not
+diverge by a single transition.
+"""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu import netsim
+from cueball_tpu.shard import FleetRouter
+
+import scenario_common as sco
+
+POOL_NAME = 'svc.sim'
+TARGET_DELAY_MS = 150.0
+# Open-loop overload (test_pool_codel's shape): 4 claims every 10ms
+# against maximum=9 slots holding 50ms each — arrivals ~400/s vs
+# service ~180/s, so the queue grows, sojourns pin over the 150ms
+# target while dequeues keep flowing, and the CoDel pacer must shed.
+BURST_PER_TICK = 4
+BURST_TICK_S = 0.01
+BURST_RUN_S = 3.0
+BURST_HOLD_S = 0.05
+
+
+async def _claim_once(claim_fn):
+    """sco.claim_once, but through an injectable claim path so the
+    sharded arm exercises router.claim_cb and the plain arm the bare
+    pool — the two paths this scenario proves equivalent. No per-claim
+    timeout: CoDel pools forbid one (the shed policy IS the timeout)."""
+    fut = asyncio.get_running_loop().create_future()
+
+    def cb(err, hdl=None, conn=None):
+        if not fut.done():
+            fut.set_result((err, hdl, conn))
+    claim_fn({}, cb)
+    return await fut
+
+
+async def _claim_release(claim_fn):
+    err, hdl, conn = await _claim_once(claim_fn)
+    if err is not None:
+        return False
+    hdl.release()
+    return True
+
+
+async def _measure_recovery_s(claim_fn, needed_ok=3,
+                              give_up_s=60.0):
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    streak = 0
+    while True:
+        if loop.time() - t0 > give_up_s:
+            raise AssertionError('no recovery within %.1fs' % give_up_s)
+        ok = await _claim_release(claim_fn)
+        streak = streak + 1 if ok else 0
+        if streak >= needed_ok:
+            return loop.time() - t0
+        await asyncio.sleep(0.1)
+
+
+async def _overload_burst(claim_fn):
+    """Sustained overload for BURST_RUN_S virtual seconds, then a full
+    drain. Entirely virtual-clock driven — identical in both arms."""
+    loop = asyncio.get_running_loop()
+    pending = [0]
+
+    def make_claim():
+        pending[0] += 1
+
+        def cb(err, hdl=None, conn=None):
+            if err is None:
+                loop.call_later(BURST_HOLD_S, hdl.release)
+            pending[0] -= 1
+        claim_fn({}, cb)
+
+    deadline = loop.time() + BURST_RUN_S
+    while loop.time() < deadline:
+        for _ in range(BURST_PER_TICK):
+            make_claim()
+        await asyncio.sleep(BURST_TICK_S)
+    while pending[0] > 0:
+        await asyncio.sleep(0.05)
+
+
+def _run_arm(seed: int, sharded: bool) -> dict:
+    fabric = netsim.Fabric()
+    sc = netsim.Scenario('sharded-failover', seed=seed)
+    result = {}
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        backends = sco.region_backends(regions=3, per_region=3)
+        router = None
+
+        def build():
+            # Same construction in both arms; the router arm runs it
+            # inside the owning shard (same loop for inline workers).
+            return sco.make_sim_pool(fabric, backends, spares=3,
+                                     maximum=9,
+                                     targetClaimDelay=TARGET_DELAY_MS)
+
+        try:
+            if sharded:
+                router = FleetRouter({'shards': 4, 'backend': 'inline'})
+                await router.start()
+            # Anchor pool creation at the same virtual instant in both
+            # arms: router startup consumes a few virtual milliseconds
+            # of state polling, and every pool-side timer must share
+            # one epoch for the traces to be comparable at all.
+            await asyncio.sleep(1.0 - loop.time())
+            if sharded:
+                rec = await router.create_pool(POOL_NAME, factory=build)
+                pool, res = rec.pool, rec.aux[0]
+                result['shard_id'] = rec.shard_id
+
+                def claim_fn(opts, cb):
+                    return router.claim_cb(POOL_NAME, opts, cb)
+            else:
+                pool, res = build()
+                claim_fn = pool.claim_cb
+            await sco.wait_state(pool, 'running', timeout_s=10.0)
+
+            sc.at(5.0, 'partition-r1',
+                  lambda: fabric.partition(sco.region_keys(pool, 1)))
+            sc.at(25.0, 'heal-r1', lambda: fabric.heal())
+
+            # Warm traffic before the fault.
+            while loop.time() < 4.5:
+                assert await _claim_release(claim_fn)
+                await asyncio.sleep(0.25)
+
+            while loop.time() < 5.01:
+                await asyncio.sleep(0.05)
+            result['recovery_s'] = await _measure_recovery_s(claim_fn)
+
+            failures = 0
+            while loop.time() < 24.5:
+                if not await _claim_release(claim_fn):
+                    failures += 1
+                await asyncio.sleep(0.25)
+            result['mid_partition_failures'] = failures
+
+            deadline = loop.time() + 30.0
+            while loop.time() < deadline and pool.p_dead:
+                await asyncio.sleep(0.5)
+            result['dead_after_heal'] = sorted(pool.p_dead)
+
+            # Overload burst from a fixed anchor, fully healed.
+            while loop.time() < 58.0:
+                await asyncio.sleep(0.1)
+            await _overload_burst(claim_fn)
+            result['codel_sheds'] = pool.get_stats()['counters'].get(
+                'codel-paced-drop', 0)
+
+            await sco.stop_pool(pool, res)
+        finally:
+            if router is not None:
+                await router.stop()
+
+    sc.run(lambda: main())
+    result['fired'] = [label for _, label in sc.fired]
+    result['shard_fsm_transitions'] = sum(
+        1 for cls, _, _ in sc.trace if cls == 'ShardFSM')
+    result['trace'] = [t for t in sc.trace if t[0] != 'ShardFSM']
+    return result
+
+
+@pytest.mark.parametrize('seed', [7, 1234])
+def test_sharded_routing_is_byte_identical_to_plain(seed):
+    plain = _run_arm(seed, sharded=False)
+    routed = _run_arm(seed, sharded=True)
+
+    # Each arm individually behaves like the regional-failover
+    # scenario: bounded recovery, no mid-partition outage, full heal,
+    # the schedule actually fired, and the burst actually shed.
+    for arm in (plain, routed):
+        assert arm['recovery_s'] < 2.5, arm
+        assert arm['mid_partition_failures'] <= 1, arm
+        assert arm['dead_after_heal'] == [], arm
+        assert arm['fired'] == ['partition-r1', 'heal-r1'], arm
+        assert arm['codel_sheds'] > 0, arm
+        assert len(arm['trace']) > 100, arm
+
+    # The routed arm ran real shard lifecycle machines...
+    assert plain['shard_fsm_transitions'] == 0
+    assert routed['shard_fsm_transitions'] > 0
+
+    # ...and yet, with those filtered out, the two runs are the SAME
+    # run: identical FSM transition sequence, identical shed count,
+    # identical recovery clock.
+    assert routed['trace'] == plain['trace']
+    assert routed['codel_sheds'] == plain['codel_sheds']
+    assert routed['recovery_s'] == plain['recovery_s']
+    assert routed['mid_partition_failures'] == \
+        plain['mid_partition_failures']

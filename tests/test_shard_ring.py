@@ -1,0 +1,84 @@
+"""Property tests for the consistent-hash shard ring (satellite of the
+shard-per-core router PR): stable assignment under shard count change,
+deterministic placement from the seed, and balance."""
+
+import collections
+
+from cueball_tpu.shard import HashRing
+
+KEYS = ['svc-%d#deadbeef%02x' % (i, i % 251) for i in range(4000)]
+
+
+def test_assignment_is_deterministic_from_seed():
+    a = HashRing(4, seed=7).assignment(KEYS)
+    b = HashRing(4, seed=7).assignment(KEYS)
+    assert a == b
+    # A different seed produces a genuinely different placement (the
+    # ring hashes with the seed as key, not via salted str concat).
+    c = HashRing(4, seed=8).assignment(KEYS)
+    assert a != c
+
+
+def test_assignment_is_independent_of_construction_order():
+    r1 = HashRing([0, 1, 2, 3], seed=3)
+    r2 = HashRing(0, seed=3)
+    for sid in (2, 0, 3, 1):
+        if sid not in r2.shards():
+            r2.add_shard(sid)
+    r2.remove_shard(0)
+    r2.add_shard(0)
+    assert r1.assignment(KEYS) == r2.assignment(KEYS)
+
+
+def test_balance_within_2x_of_even():
+    for k in (2, 4, 8):
+        counts = collections.Counter(
+            HashRing(k, seed=0).assignment(KEYS).values())
+        assert len(counts) == k, 'some shard got zero keys'
+        even = len(KEYS) / k
+        for sid, n in counts.items():
+            assert 0.5 * even <= n <= 2.0 * even, (k, counts)
+
+
+def test_adding_a_shard_moves_about_one_kth():
+    """The consistent-hashing contract: growing K -> K+1 moves ~1/(K+1)
+    of the keys, and every moved key moves TO the new shard (keys never
+    shuffle between surviving shards)."""
+    for k in (2, 4, 8):
+        before = HashRing(k, seed=1).assignment(KEYS)
+        ring = HashRing(k, seed=1)
+        ring.add_shard(k)
+        after = ring.assignment(KEYS)
+        moved = [key for key in KEYS if before[key] != after[key]]
+        for key in moved:
+            assert after[key] == k, 'key shuffled between old shards'
+        frac = len(moved) / len(KEYS)
+        # Expect 1/(k+1); allow generous slack for hash variance.
+        assert frac <= 2.0 / (k + 1), (k, frac)
+        assert frac >= 0.25 / (k + 1), (k, frac)
+
+
+def test_removing_a_shard_only_moves_its_keys():
+    ring = HashRing(5, seed=2)
+    before = ring.assignment(KEYS)
+    ring.remove_shard(3)
+    after = ring.assignment(KEYS)
+    for key in KEYS:
+        if before[key] != 3:
+            assert after[key] == before[key]
+        else:
+            assert after[key] != 3
+
+
+def test_add_remove_roundtrip_restores_assignment():
+    ring = HashRing(4, seed=9)
+    before = ring.assignment(KEYS)
+    ring.remove_shard(2)
+    ring.add_shard(2)
+    assert ring.assignment(KEYS) == before
+
+
+def test_single_shard_takes_everything():
+    ring = HashRing(1, seed=0)
+    assert set(ring.assignment(KEYS).values()) == {0}
+    assert len(ring) == 1

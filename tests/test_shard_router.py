@@ -1,0 +1,343 @@
+"""FleetRouter behavior: lifecycle, routed claims, dead-shard error
+paths, restart/rebuild, and the merged observability surfaces
+(metrics / kang / SIGUSR2 dump / trace export)."""
+
+import asyncio
+import os
+
+import pytest
+
+from conftest import run_async, settle, wait_for_state
+
+from bench import _bench_fixture_pool
+from cueball_tpu import trace as mod_trace
+from cueball_tpu.errors import CueBallError
+from cueball_tpu.metrics import create_collector
+from cueball_tpu.monitor import pool_monitor
+from cueball_tpu.shard import (FleetRouter, RoutedClaim, ShardDeadError,
+                               active_routers)
+
+
+async def _stop_pool_and_router(router, *names):
+    for name in names:
+        await router.destroy_pool(name)
+    await router.stop()
+
+
+def test_lifecycle_thread_backend():
+    async def main():
+        router = FleetRouter({'shards': 2, 'backend': 'thread', 'seed': 5})
+        await router.start()
+        assert router.shard_states() == {0: 'running', 1: 'running'}
+        assert router in active_routers()
+        snap = router.snapshot()
+        assert snap['backend'] == 'thread'
+        assert snap['nshards'] == 2
+        assert snap['seed'] == 5
+        assert snap['states'] == {'0': 'running', '1': 'running'}
+        await router.stop()
+        assert router.shard_states() == {0: 'stopped', 1: 'stopped'}
+        assert router not in active_routers()
+    run_async(main())
+
+
+def test_lifecycle_inline_backend():
+    async def main():
+        router = FleetRouter({'shards': 3, 'backend': 'inline'})
+        await router.start()
+        assert set(router.shard_states().values()) == {'running'}
+        # Inline workers share the caller's loop.
+        loop = asyncio.get_running_loop()
+        assert all(w.loop is loop for w in router.fr_workers.values())
+        await router.stop()
+        assert set(router.shard_states().values()) == {'stopped'}
+    run_async(main())
+
+
+def test_router_option_validation():
+    with pytest.raises(ValueError):
+        FleetRouter({'shards': 0})
+    with pytest.raises(ValueError):
+        FleetRouter({'backend': 'fork'})
+
+    async def main():
+        router = FleetRouter({'shards': 1, 'backend': 'inline'})
+        with pytest.raises(CueBallError):
+            await router.create_pool('too-early', factory=_bench_fixture_pool)
+        await router.start()
+        with pytest.raises(CueBallError):
+            await router.start()
+        with pytest.raises(ValueError):
+            await router.create_pool('svc.x')      # neither options/factory
+        with pytest.raises(ValueError):
+            await router.create_pool('svc.x', options={'domain': 'x'},
+                                     factory=_bench_fixture_pool)
+        await router.stop()
+    run_async(main())
+
+
+def test_pool_key_is_stable_and_options_sensitive():
+    k1 = FleetRouter.pool_key('svc', {'maximum': 4, 'spares': 2})
+    k2 = FleetRouter.pool_key('svc', {'spares': 2, 'maximum': 4})
+    assert k1 == k2                       # order-insensitive
+    assert k1.startswith('svc#')
+    assert k1 != FleetRouter.pool_key('svc', {'maximum': 8, 'spares': 2})
+    assert FleetRouter.pool_key('svc') == 'svc'          # no options: bare
+    # Non-scalar option values contribute their type name only, so the
+    # key is reproducible across processes (function addresses differ).
+    ka = FleetRouter.pool_key('svc', {'constructor': _bench_fixture_pool})
+    kb = FleetRouter.pool_key('svc', {'constructor': _stop_pool_and_router})
+    assert ka == kb
+
+
+def test_async_claim_and_routed_release():
+    async def main():
+        router = FleetRouter({'shards': 2, 'backend': 'thread'})
+        await router.start()
+        rec = await router.create_pool('svc.claim',
+                                       factory=_bench_fixture_pool)
+        assert rec.shard_id == router.fr_ring.assign('svc.claim')
+        assert router.get_pool('svc.claim').p_shard == rec.shard_id
+
+        claim = await router.claim('svc.claim')
+        assert isinstance(claim, RoutedClaim)
+        assert claim.rc_shard == rec.shard_id
+        assert claim.connection is not None
+        before = router.fr_submits[rec.shard_id]
+        await claim.release()
+        assert router.fr_submits[rec.shard_id] == before + 1
+
+        # The handle can be reclaimed after release.
+        claim2 = await router.claim('svc.claim')
+        await claim2.release()
+        await _stop_pool_and_router(router, 'svc.claim')
+    run_async(main())
+
+
+def test_claim_cb_cross_loop_marshals_callback_back():
+    async def main():
+        router = FleetRouter({'shards': 1, 'backend': 'thread'})
+        await router.start()
+        await router.create_pool('svc.cb', factory=_bench_fixture_pool)
+        caller_loop = asyncio.get_running_loop()
+        done = asyncio.Event()
+        seen = {}
+
+        def cb(err, hdl=None, conn=None):
+            seen['err'] = err
+            seen['hdl'] = hdl
+            seen['loop'] = asyncio.get_running_loop()
+            done.set()
+
+        # Cross-loop: posts to the shard, returns None immediately.
+        assert router.claim_cb('svc.cb', {}, cb) is None
+        await asyncio.wait_for(done.wait(), 10.0)
+        assert seen['err'] is None
+        assert seen['loop'] is caller_loop    # marshalled back to us
+        hdl = seen['hdl']
+        # Release must run on the owning shard's loop, not ours.
+        await router.submit('svc.cb', lambda _pool: hdl.release())
+        await _stop_pool_and_router(router, 'svc.cb')
+    run_async(main())
+
+
+def test_claim_cb_inline_is_direct():
+    async def main():
+        router = FleetRouter({'shards': 2, 'backend': 'inline'})
+        await router.start()
+        await router.create_pool('svc.inl', factory=_bench_fixture_pool)
+        done = asyncio.Event()
+        seen = {}
+
+        def cb(err, hdl=None, conn=None):
+            seen['hdl'] = hdl
+            done.set()
+
+        # Same loop: direct pool.claim_cb call, handle returned.
+        router.claim_cb('svc.inl', {}, cb)
+        await asyncio.wait_for(done.wait(), 10.0)
+        seen['hdl'].release()
+        await _stop_pool_and_router(router, 'svc.inl')
+    run_async(main())
+
+
+def test_claim_on_unknown_pool_raises_keyerror():
+    async def main():
+        router = FleetRouter({'shards': 1, 'backend': 'inline'})
+        await router.start()
+        with pytest.raises(KeyError):
+            await router.claim('nope')
+        await router.stop()
+    run_async(main())
+
+
+# Killing the loop strands the in-flight job's coroutine by design;
+# the warning it emits on GC is the scenario under test.
+@pytest.mark.filterwarnings('ignore::RuntimeWarning')
+def test_dead_shard_mid_claim_errors_and_restart_rebuilds():
+    """The no-deadlock guarantee: a job in flight on a dying shard gets
+    ShardDeadError (not a hang), new routed work fails fast, the
+    watchdog flips the FSM to failed, and restart_shard rebuilds the
+    pools the dead loop owned."""
+    async def main():
+        router = FleetRouter({'shards': 1, 'backend': 'thread'})
+        await router.start()
+        rec = await router.create_pool('svc.dead',
+                                       factory=_bench_fixture_pool)
+        sid = rec.shard_id
+        worker = router.fr_workers[sid]
+        fsm = router.fr_fsms[sid]
+        old_pool = rec.pool
+
+        async def hang(_pool):
+            await asyncio.sleep(60)
+
+        pending = asyncio.ensure_future(router.submit('svc.dead', hang))
+        await settle(20)
+        assert not pending.done()
+
+        # Kill the shard loop out from under the pending job.
+        worker.request_stop()
+        with pytest.raises(ShardDeadError):
+            await asyncio.wait_for(pending, 5.0)
+
+        # New routed work fails fast while the loop is gone.
+        with pytest.raises(ShardDeadError):
+            await router.claim('svc.dead')
+        with pytest.raises(ShardDeadError):
+            router.claim_cb('svc.dead', {}, lambda *a: None)
+        with pytest.raises(ShardDeadError):
+            await router.run_on(sid, lambda: None)
+
+        # The running-state watchdog notices and lands in 'failed'.
+        await wait_for_state(fsm, 'failed', timeout=5.0)
+        with pytest.raises(ShardDeadError):
+            await router.create_pool('svc.more',
+                                     factory=_bench_fixture_pool)
+
+        await router.restart_shard(sid)
+        assert fsm.is_in_state('running')
+        assert rec.pool is not None and rec.pool is not old_pool
+        claim = await router.claim('svc.dead')
+        assert claim.connection is not None
+        await claim.release()
+        await _stop_pool_and_router(router, 'svc.dead')
+    run_async(main())
+
+
+def test_restart_requires_failed_state():
+    async def main():
+        router = FleetRouter({'shards': 1, 'backend': 'thread'})
+        await router.start()
+        # Running shard: restart is a no-op, not an error.
+        await router.restart_shard(0)
+        assert router.fr_fsms[0].is_in_state('running')
+        await router.stop()
+        with pytest.raises(CueBallError):
+            await router.restart_shard(0)     # stopped, not failed
+    run_async(main())
+
+
+def test_attach_metrics_publishes_shard_labelled_gauges():
+    async def main():
+        router = FleetRouter({'shards': 2, 'backend': 'thread'})
+        await router.start()
+        await router.create_pool('svc.met', factory=_bench_fixture_pool)
+        coll = create_collector()
+        router.attach_metrics(coll)
+        with pytest.raises(CueBallError):
+            router.attach_metrics(coll)
+        text = coll.collect()
+        assert 'cueball_shard_up{shard="0"} 1' in text
+        assert 'cueball_shard_up{shard="1"} 1' in text
+        sid = router.fr_pools['svc.met'].shard_id
+        assert 'cueball_shard_pools{shard="%d"} 1' % sid in text
+        assert 'cueball_shard_submits{shard=' in text
+        # stop() detaches the collect hook.
+        await _stop_pool_and_router(router, 'svc.met')
+        assert router.fr_collector is None
+    run_async(main())
+
+
+def test_monitor_kang_and_debug_surfaces_are_merged():
+    async def main():
+        from cueball_tpu.debug import dump_fsm_histories
+        from cueball_tpu.http_server import _route
+        router = FleetRouter({'shards': 2, 'backend': 'thread'})
+        await router.start()
+        await router.create_pool('svc.obs', factory=_bench_fixture_pool)
+        pool = router.get_pool('svc.obs')
+        sid = router.fr_pools['svc.obs'].shard_id
+
+        obj = pool_monitor.get('pool', pool.p_uuid)
+        assert obj['shard'] == sid
+
+        snap = pool_monitor.snapshot()
+        assert any(s['backend'] == 'thread' and 'svc.obs' in s['pools']
+                   for s in snap['shards'])
+
+        text = dump_fsm_histories()
+        assert 'fleet_router backend=thread shards=2' in text
+        assert 'shard=%d' % sid in text
+        assert 'svc.obs' in text
+
+        status, ctype, body = _route('GET', '/kang/shards', None)
+        assert status == 200
+        assert b'"svc.obs"' in body and b'"thread"' in body
+
+        await _stop_pool_and_router(router, 'svc.obs')
+    run_async(main())
+
+
+def test_trace_export_stamps_shard_id():
+    async def main():
+        router = FleetRouter({'shards': 1, 'backend': 'thread'})
+        mod_trace.enable_tracing(ring_size=256, sample_rate=1.0)
+        try:
+            await router.start()
+            await router.create_pool('svc.tr', factory=_bench_fixture_pool)
+            claim = await router.claim('svc.tr')
+            await claim.release()
+            await settle(20)
+            out = mod_trace.export_ndjson()
+            shard_lines = [ln for ln in out.splitlines()
+                           if '"shard"' in ln]
+            assert shard_lines, 'no shard-stamped spans in export'
+            assert any('"shard": 0' in ln or '"shard":0' in ln
+                       for ln in shard_lines)
+            await _stop_pool_and_router(router, 'svc.tr')
+        finally:
+            mod_trace.disable_tracing()
+    run_async(main())
+
+
+def test_sample_fleet_reduces_across_shards():
+    async def main():
+        router = FleetRouter({'shards': 2, 'backend': 'thread'})
+        await router.start()
+        await router.create_pool('svc.fl', factory=_bench_fixture_pool)
+        fleet = await router.sample_fleet()
+        assert fleet['n_pools'] >= 1.0
+        await _stop_pool_and_router(router, 'svc.fl')
+    run_async(main())
+
+
+def test_spawn_backend_runs_jobs_in_child_processes():
+    """One live spawn smoke: two children, both reachable, distinct
+    pids (and distinct from ours). Per-claim routing is refused."""
+    async def main():
+        router = FleetRouter({'shards': 2, 'backend': 'spawn'})
+        await router.start(timeout_s=60.0)
+        try:
+            pings = [await router.run_on(sid,
+                                         'cueball_tpu.shard.proc:_ping')
+                     for sid in (0, 1)]
+            pids = {p['pid'] for p in pings}
+            assert len(pids) == 2
+            assert os.getpid() not in pids
+            assert [p['shard'] for p in pings] == [0, 1]
+            with pytest.raises(CueBallError):
+                await router.sample_fleet()
+        finally:
+            await router.stop()
+    run_async(main(), timeout=120.0)
