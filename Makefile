@@ -4,8 +4,9 @@ PYTHON ?= python3
 LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
-.PHONY: test check bench bench-host bench-sharded dryrun coverage \
-	native ci docs docs-check fsm-graph scenarios scenarios-fast
+.PHONY: test check bench bench-host bench-sharded bench-control \
+	dryrun coverage native ci docs docs-check fsm-graph scenarios \
+	scenarios-fast
 
 native:
 	$(PYTHON) native/build.py
@@ -54,10 +55,18 @@ bench:
 	$(PYTHON) bench.py
 
 # Host-path stages only (codel tracking, claim throughput, sampler
-# tick cost): no accelerator, no chip subprocess, no 300s telemetry
-# timeout. Emits the same single JSON line with host_only=true.
+# tick cost, plus the bench-control stages: the 10k->1M telemetry/
+# control sweep and the actuation-hooks A/B run inside --host-only):
+# no accelerator, no chip subprocess, no 300s telemetry timeout.
+# Emits the same single JSON line with host_only=true.
 bench-host:
 	$(PYTHON) bench.py --host-only
+
+# Control-plane stages alone (docs/control-plane.md): the jitted
+# control-step sweep at 10k/100k/1M pools next to the telemetry live
+# step, and the controlActuation claim-path A/B. One JSON line.
+bench-control:
+	$(PYTHON) bench.py --control-only
 
 # The shard-router scaling sweep only (docs/sharding.md): K=1,2,4,8
 # spawn-backend shards, aggregate claim throughput per K, and the

@@ -165,6 +165,34 @@ try:
 except AttributeError:
     _ALL_CORES = list(range(os.cpu_count() or 1))
 
+
+def _physical_cores():
+    """Distinct (physical id, core id) pairs from /proc/cpuinfo: the
+    number of real cores behind the logical ones, or None when the
+    file is unreadable (non-Linux) or carries no topology. BENCH_r08
+    ran the K=8 sharded arm on `cores: 1` with nothing in the record
+    flagging the oversubscription — the sharded stage now records the
+    full accounting (affinity cores, physical cores, cpu_count) and
+    annotates every arm where K exceeds the cores it can use."""
+    try:
+        pairs = set()
+        phys = core = None
+        with open('/proc/cpuinfo', encoding='utf-8') as f:
+            for line in f:
+                if line.startswith('physical id'):
+                    phys = line.split(':', 1)[1].strip()
+                elif line.startswith('core id'):
+                    core = line.split(':', 1)[1].strip()
+                elif not line.strip():
+                    if phys is not None or core is not None:
+                        pairs.add((phys, core))
+                    phys = core = None
+        if phys is not None or core is not None:
+            pairs.add((phys, core))
+        return len(pairs) or None
+    except OSError:
+        return None
+
 # Warm-state settle (r7: trial-to-trial spread was bimodal 15.1k-23.7k
 # even after GC discipline — trial 1 regularly landed before allocator/
 # malloc arenas and CPU frequency settled): before the measured trials,
@@ -535,24 +563,38 @@ async def bench_sharded_claims(ks=SHARDED_KS, trials=SHARDED_TRIALS,
             'child_rate_mean': round(statistics.mean(
                 [r for row in child_rates for r in row]), 1),
             'settle_batches': [s['settle_batches'] for s in settles],
+            # K shards time-slicing fewer cores cannot show K-way
+            # scaling; the arm still runs (cross-round comparability)
+            # but says so instead of wearing a scaling claim.
+            'oversubscribed': k > cores,
         }
     k_lo, k_hi = str(min(ks)), str(max(ks))
     base = arms[k_lo]['aggregate_median']
     top = arms[k_hi]['aggregate_median']
     expected = base * min(max(ks), cores)
+    raw_expected = base * max(ks)
     return {
-        'ks': list(ks), 'cores': cores, 'backend': backend,
+        'ks': list(ks), 'cores': cores,
+        'physical_cores': _physical_cores(),
+        'cpu_count': os.cpu_count(),
+        'oversubscribed_ks': [k for k in ks if k > cores],
+        'backend': backend,
         'ops_per_shard': SHARDED_OPS,
         'outstanding': QUEUED_OUTSTANDING,
         'trials': trials,
         'arms': arms,
         'linear_fraction': round(top / expected, 3) if expected else None,
+        'linear_fraction_raw': round(top / raw_expected, 3)
+        if raw_expected else None,
         'protocol': ('per K in %s: router(backend=%s) + 1 ring-placed '
                      'fixture pool per shard, 1 settle round, %d timed '
                      'rounds of %d ops x %d outstanding per shard; '
                      'aggregate = K*ops/wall across a gather barrier; '
                      'linear_fraction = median(K=%s)/(median(K=%s)*'
-                     'min(K,cores))') % (
+                     'min(K,cores)) — core-normalized; '
+                     'linear_fraction_raw divides by K alone, so on a '
+                     'box with fewer cores than K it reports the '
+                     'honest sub-1/K figure') % (
             list(ks), backend, trials, SHARDED_OPS,
             QUEUED_OUTSTANDING, k_hi, k_lo),
     }
@@ -693,6 +735,98 @@ async def bench_tracing_ab(ops=TRACING_AB_OPS_PER_TRIAL,
     return out
 
 
+async def bench_actuation_ab(ops=TRACING_AB_OPS_PER_TRIAL,
+                             trials=TRACING_AB_TRIALS):
+    """controlActuation-off vs -on claim-path A/B (ISSUE 9 acceptance:
+    the actuation hooks must cost <= 1% on the claim hot path while
+    the control plane is idle).
+
+    Same interleaved three-arm protocol as the tracing A/B — off-pre,
+    on, off-post each round against one settled pool, so host drift
+    lands on all three arms equally. The 'on' arm runs with the pool's
+    controlActuation flag set (exactly the attribute the constructor
+    option sets) AND with one accepted control decision already
+    applied, so the measured path includes whatever state an accept
+    leaves behind (epoch/timestamp stamps) — the honest idle-plane
+    worst case. The actuation API itself is out-of-band (sampler tick
+    / router.run_on), so the expected delta is the noise floor."""
+    import gc
+    import statistics
+    build_pool = make_fixture()
+    pool = build_pool()
+    await settle(pool)
+
+    async def run_arm(actuation):
+        pool.p_control_actuation = bool(actuation)
+        if actuation:
+            # One accepted, value-identical decision: stamps the
+            # epoch/clock fields without moving spares or CoDel.
+            ok = pool.apply_control_decision(
+                pool.p_ctrl_epoch + 1, spares=pool.p_spares)
+            assert ok, 'idle-plane decision unexpectedly rejected'
+        try:
+            gc.disable()
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                hdl, conn = await pool.claim({'timeout': 1000})
+                hdl.release()
+            elapsed = time.perf_counter() - t0
+            gc.enable()
+        finally:
+            pool.p_control_actuation = False
+        return ops / elapsed
+
+    arms = {'off_pre': [], 'on': [], 'off_post': []}
+    warmup = True
+    frozen = False
+    speed_redos = 0
+    while len(arms['on']) < trials:
+        if not warmup and not frozen:
+            gc.collect()
+            gc.freeze()
+            frozen = True
+        gc.collect()
+        await speed_gate()
+        rates = {arm: await run_arm(arm == 'on') for arm in arms}
+        clean = _speed_ok(_speed_probe())
+        if warmup:
+            warmup = False
+            continue
+        if not clean and speed_redos < trials:
+            speed_redos += 1
+            continue
+        for arm, rate in rates.items():
+            arms[arm].append(rate)
+    pool.stop()
+    while not pool.is_in_state('stopped'):
+        await asyncio.sleep(0.01)
+
+    out = {}
+    for arm, xs in arms.items():
+        out[arm + '_ops_per_sec'] = round(statistics.mean(xs), 1)
+        out[arm + '_stdev'] = round(
+            statistics.stdev(xs) if len(xs) > 1 else 0.0, 1)
+        out[arm + '_trials'] = [round(r, 1) for r in xs]
+    per_round = []
+    for i in range(len(arms['on'])):
+        off_i = (arms['off_pre'][i] + arms['off_post'][i]) / 2.0
+        per_round.append(100.0 * (off_i - arms['on'][i]) / off_i)
+    out['actuation_on_overhead_pct_rounds'] = [
+        round(x, 2) for x in per_round]
+    out['actuation_on_overhead_pct'] = round(
+        statistics.median(per_round), 2)
+    out['speed_gate_redone_rounds'] = speed_redos
+    out['protocol'] = ('%d rounds x %d ops x 3 interleaved arms '
+                       '(off-pre / on / off-post) back to back against '
+                       'one settled pool; on = controlActuation set '
+                       'with one accepted idle decision applied; 1 '
+                       'warmup round, gc frozen+disabled in timed '
+                       'sections, speed-gated with degraded rounds '
+                       'redone; overhead pct is the median of '
+                       'per-round paired deltas') % (trials, ops)
+    return out
+
+
 async def bench_pump_ab(ops=CLAIM_OPS_PER_TRIAL, trials=CLAIM_TRIALS):
     """Pump-off vs pump-on claim-path A/B (the tentpole's receipt).
 
@@ -804,12 +938,17 @@ TELEM_POOLS = 1 << 20
 TELEM_SMALL = 1 << 16
 TELEM_TICK_SIZES = (1024, 10240, 102400)
 
+# The 10k->1M fleet-size sweep shared by the telemetry live step and
+# the control step (ISSUE 9): one arm must sit at or above 100k pools.
+CONTROL_SIZES = (10_240, 102_400, 1_048_576)
+
 # The code whose behavior the chip numbers measure: the kernels, the
 # batched laws + shardings, the entry shapes, AND the live sampler +
 # monitor (the tick_cost stages time FleetSampler.sample_once end to
 # end). The protocol shapes are folded in separately below so a shape
 # change stales the artifact without hashing all of bench.py.
 _TELEM_CODE = ('cueball_tpu/ops', 'cueball_tpu/parallel/telemetry.py',
+               'cueball_tpu/parallel/control.py',
                'cueball_tpu/parallel/sampler.py',
                'cueball_tpu/monitor.py', '__graft_entry__.py')
 
@@ -838,7 +977,7 @@ def telemetry_code_hash() -> str:
         with open(p, 'rb') as f:
             h.update(f.read())
     h.update(repr((TELEM_POOLS, TELEM_SMALL,
-                   TELEM_TICK_SIZES)).encode())
+                   TELEM_TICK_SIZES, CONTROL_SIZES)).encode())
     return h.hexdigest()[:16]
 
 
@@ -946,6 +1085,14 @@ def bench_telemetry_stages(emit, pools=TELEM_POOLS):
           'small_pools_per_sec': live_rate(small, 100)})
     emit({'stage': 'step_live', 'pools': pools,
           'pools_per_sec_live': live_rate(pools, 50)})
+
+    # The 10k->1M telemetry + control sweep (ISSUE 9). Runs right
+    # after the live step so a wedge in the heavier undonated/scan
+    # stages below never costs the round its control numbers. A CI
+    # pools override caps the sweep the same way it caps step_live.
+    sweep_sizes = tuple(s for s in CONTROL_SIZES if s <= pools) \
+        or (pools,)
+    emit(dict(_fleet_sweeps(sweep_sizes), stage='fleet_sweep'))
 
     state, inp = _example_inputs(pools)
 
@@ -1082,6 +1229,80 @@ def bench_sampler_tick_host(sizes=(1024, 10240)) -> dict:
     return out
 
 
+def _fleet_sweeps(sizes=CONTROL_SIZES) -> dict:
+    """The 10k->1M fleet-size sweep: pools/sec through the donated
+    telemetry live step AND the donated control step, per size, on
+    whatever backend the calling process sees. One protocol shared by
+    the chip child and the host fallback so the two columns are always
+    comparable. Inputs are deterministic but non-degenerate (loads
+    cycle 0..7, sojourns cycle 0..699 against a 500 ms CoDel target)
+    so the control step's over/relax branches both stay live."""
+    import jax
+    import jax.numpy as jnp
+    from __graft_entry__ import _example_inputs
+    from cueball_tpu.parallel import control as ctl
+    from cueball_tpu.parallel.telemetry import make_live_step
+
+    live = make_live_step()
+    cstep = ctl.make_control_step()
+    telem = {}
+    ctrl = {}
+    for n in sizes:
+        iters = max(10, min(100, 4_000_000 // n))
+        state, inp = _example_inputs(n)
+        out = live(state, inp)           # compile + donate the init
+        jax.block_until_ready(out)
+        state = out[0]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, _out, _fleet = live(state, inp)
+        jax.block_until_ready(state)
+        telem[str(n)] = round(n * iters / (time.perf_counter() - t0), 1)
+
+        idx = jnp.arange(n, dtype=jnp.float32)
+        cinp = ctl.control_inputs(
+            n,
+            samples=idx % 8.0,
+            sojourns=idx % 700.0,
+            filtered=(idx % 8.0) * 0.9,
+            target_delay=jnp.full((n,), 500.0, jnp.float32),
+            spares=jnp.full((n,), 2.0, jnp.float32),
+            active=jnp.ones((n,), bool),
+            now_ms=1000.0)
+        cstate = ctl.control_init(n)
+        out = cstep(cstate, cinp)        # compile + donate the init
+        jax.block_until_ready(out)
+        cstate = out[0]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            cstate, _dec, _fl = cstep(cstate, cinp)
+        jax.block_until_ready(cstate)
+        ctrl[str(n)] = round(n * iters / (time.perf_counter() - t0), 1)
+    return {'telemetry_pools_per_sec_sweep': telem,
+            'control_step_pools_per_sec': ctrl}
+
+
+def bench_fleet_sweeps_host(sizes=CONTROL_SIZES) -> dict:
+    """The fleet-size sweep on the HOST CPU backend: the guarantee that
+    `telemetry_pools_per_sec` and `control_step_pools_per_sec` are
+    never silently null (every chip field in BENCH_r06..r08 was).
+    Same CPU-pinning rules as bench_sampler_tick_host — the container
+    sitecustomize force-registers the TPU backend and a wedged tunnel
+    blocks backend init indefinitely, so this must pin CPU itself."""
+    try:
+        import jax
+    except ImportError:
+        return {}
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except RuntimeError:
+        if jax.default_backend() != 'cpu':
+            return {}
+    out = _fleet_sweeps(sizes)
+    out['backend'] = jax.default_backend()
+    return out
+
+
 def _telemetry_child_main(progress_path: str) -> None:
     """Child-process entry: run the stages against the real backend,
     appending each stage to the progress file as it lands."""
@@ -1146,7 +1367,18 @@ def chip_probe(timeout_s: float = 45.0) -> dict:
     'cpu-pinned-env' (JAX_PLATFORMS pins cpu; CI exercising the staged
     path — the stage still runs, on the host backend), 'cpu-only' (jax
     came up but only with the host backend), 'timeout' (tunnel not
-    answering), 'failed' (probe subprocess errored)."""
+    answering), 'failed' (probe subprocess errored).
+
+    Every record carries `code_hash` — the measured-path hash the
+    probe ran under — so the round says not just whether a capture was
+    attempted but exactly which code a successful one would have
+    measured (the hash-matched opportunistic capture protocol)."""
+    out = _chip_probe(timeout_s)
+    out['code_hash'] = telemetry_code_hash()
+    return out
+
+
+def _chip_probe(timeout_s: float) -> dict:
     import subprocess
     import sys
     probe = 'import jax; print(jax.default_backend())'
@@ -1219,24 +1451,35 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0,
 
     A cheap backend PROBE runs first (probe_timeout_s): when no
     accelerator answers at all — tunnel absent rather than wedged
-    mid-run — the stage reports that in seconds instead of sitting
-    out the full run timeout. An explicit JAX_PLATFORMS=cpu request
-    (CI exercising the staged path) skips the probe: the CPU backend
-    is always there."""
+    mid-run — the stages run anyway on the host CPU backend, labelled
+    capture='cpu-fallback', so the round's chip columns carry real
+    (if slower) numbers with their backend on record instead of
+    silent nulls. An explicit JAX_PLATFORMS=cpu request (CI
+    exercising the staged path) is honored the same way."""
     import subprocess
     import sys
     import tempfile
     root = os.path.dirname(os.path.abspath(__file__))
     if probe is None:
         probe = chip_probe()
+    env = None
+    capture = 'accelerator'
     if probe['outcome'] in ('timeout', 'failed', 'cpu-only'):
-        # No chip: minutes of CPU-run stages would wear a chip stage's
-        # labels. The committed artifact citation covers the JSON
-        # instead (assemble_result).
-        err = 'no accelerator: %s; skipping the chip stage' % (
-            probe['detail'])
-        print('bench: %s' % err, file=sys.stderr)
-        return {'stages_completed': [], 'error': err}
+        # No chip answered. r06 and r08 skipped here and emitted a
+        # round of null chip fields; instead capture the SAME staged
+        # protocol on the host CPU backend, explicitly labelled
+        # (capture='cpu-fallback', backend from the child's device
+        # stage), so the round always carries measured numbers. The
+        # child pins cpu via JAX_PLATFORMS — honored by
+        # _telemetry_child_main through jax.config — so a wedged chip
+        # tunnel is never touched.
+        capture = 'cpu-fallback'
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        print('bench: no accelerator (%s); capturing the staged '
+              'telemetry protocol on the host CPU backend instead'
+              % probe['detail'], file=sys.stderr)
+    elif probe['outcome'] == 'cpu-pinned-env':
+        capture = 'cpu-pinned-env'
     fd, progress = tempfile.mkstemp(prefix='bench_telem_',
                                     suffix='.jsonl')
     os.close(fd)
@@ -1246,7 +1489,7 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0,
     try:
         r = subprocess.run([sys.executable, '-c', code],
                            capture_output=True, text=True,
-                           timeout=timeout_s)
+                           timeout=timeout_s, env=env)
         if r.returncode != 0:
             # Distinguish a broken bench path from a missing
             # accelerator in the JSON itself (a null rate alone would
@@ -1273,6 +1516,7 @@ def bench_telemetry_step_guarded(timeout_s: float = 300.0,
         except OSError:
             pass
     acc['stages_completed'] = stages
+    acc['capture'] = capture
     if err is not None:
         acc['error'] = err
         print('bench: %s; %d chip stage(s) landed before that' % (
@@ -1332,7 +1576,8 @@ def artifact_citation(root: str | None = None) -> dict:
 
 def assemble_result(abs_err, claim, queued, host_tick, telem,
                     tracing_ab=None, pump_ab=None,
-                    probe=None, sharded=None) -> dict:
+                    probe=None, sharded=None, sweeps=None,
+                    actuation_ab=None) -> dict:
     """Build the single JSON-line result from the stage outputs.
 
     Factored out of main() so the guard tests can assert the
@@ -1417,6 +1662,35 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
         'device': telem.get('device'),
         'targets_ms': TARGETS,
     }
+    # The 10k->1M telemetry/control sweep: the chip child's copy wins
+    # (it saw the real backend); the host CPU copy fills in otherwise,
+    # with the backend that produced each column on record — the
+    # "never silently null" rule.
+    sweeps = sweeps or {}
+    ctrl_sweep = (telem.get('control_step_pools_per_sec')
+                  or sweeps.get('control_step_pools_per_sec'))
+    telem_sweep = (telem.get('telemetry_pools_per_sec_sweep')
+                   or sweeps.get('telemetry_pools_per_sec_sweep'))
+    result['control_step_pools_per_sec'] = ctrl_sweep
+    result['telemetry_pools_per_sec_sweep'] = telem_sweep
+    result['telemetry_capture'] = telem.get('capture')
+    result['telemetry_backend'] = telem.get('backend')
+    if ctrl_sweep is not None:
+        result['control_step_backend'] = (
+            telem.get('backend')
+            if telem.get('control_step_pools_per_sec') is not None
+            else sweeps.get('backend'))
+    if result['telemetry_pools_per_sec'] is None and telem_sweep:
+        # No chip-child live rate landed: the headline falls back to
+        # the host sweep's largest arm, labelled with its backend, so
+        # the round still records a measured number (the citation
+        # below still points at the committed chip artifact).
+        top = max(telem_sweep, key=int)
+        result['telemetry_pools_per_sec'] = telem_sweep[top]
+        result['telemetry_backend'] = (
+            telem.get('backend') or sweeps.get('backend'))
+    if actuation_ab is not None:
+        result['claim_actuation_ab'] = actuation_ab
     if tracing_ab is not None:
         result['claim_tracing_ab'] = tracing_ab
     if pump_ab is not None:
@@ -1449,13 +1723,17 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
     return result
 
 
-async def main(host_only: bool = False, sharded_only: bool = False):
+async def main(host_only: bool = False, sharded_only: bool = False,
+               control_only: bool = False):
     """Run the bench and print ONE JSON line.
 
     host_only=True (the `make bench-host` / --host-only path) runs
     every host-CPU stage — codel tracking, claim throughput, the
-    sampler tick cost — and skips the chip subprocess entirely: no
-    accelerator touched, no 300 s telemetry timeout to wait out."""
+    sampler tick cost, the telemetry/control fleet sweep — and skips
+    the chip subprocess entirely: no accelerator touched, no 300 s
+    telemetry timeout to wait out. control_only=True (`make
+    bench-control`) runs just the control-plane stages: the 10k->1M
+    telemetry/control sweep plus the actuation-hooks claim A/B."""
     # Pin THIS process to CPU: the host benchmarks must not share the
     # GIL with the axon tunnel machinery (its retry threads measurably
     # depress claim throughput when the chip tunnel is unhealthy). The
@@ -1489,6 +1767,22 @@ async def main(host_only: bool = False, sharded_only: bool = False):
         print(json.dumps(out))
         return
 
+    if control_only:
+        # `make bench-control`: the control-plane stages alone.
+        sweeps = bench_fleet_sweeps_host()
+        actuation_ab = await bench_actuation_ab()
+        print(json.dumps({
+            'control_only': True,
+            'control_step_pools_per_sec':
+                sweeps.get('control_step_pools_per_sec'),
+            'telemetry_pools_per_sec_sweep':
+                sweeps.get('telemetry_pools_per_sec_sweep'),
+            'control_step_backend': sweeps.get('backend'),
+            'claim_actuation_ab': actuation_ab,
+            'telemetry_code_hash': telemetry_code_hash(),
+        }))
+        return
+
     # Probe the chip FIRST and carry the outcome into the round
     # record: --host-only rounds used to emit every chip field as a
     # bare null with nothing saying whether a capture was even
@@ -1502,13 +1796,22 @@ async def main(host_only: bool = False, sharded_only: bool = False):
     sharded = await bench_sharded_claims_guarded()
     tracing_ab = await bench_tracing_ab()
     pump_ab = await bench_pump_ab()
+    actuation_ab = await bench_actuation_ab()
     host_tick = bench_sampler_tick_host()
     telem = {} if host_only else bench_telemetry_step_guarded(
         probe=probe)
+    # The host copy of the 10k->1M telemetry/control sweep runs
+    # whenever the chip child didn't land its own (host_only, a wedge
+    # before the sweep stage): the sweep columns must never be null.
+    sweeps = {}
+    if telem.get('control_step_pools_per_sec') is None \
+            or telem.get('telemetry_pools_per_sec_sweep') is None:
+        sweeps = bench_fleet_sweeps_host()
 
     result = assemble_result(abs_err, claim, queued, host_tick, telem,
                              tracing_ab=tracing_ab, pump_ab=pump_ab,
-                             probe=probe, sharded=sharded)
+                             probe=probe, sharded=sharded,
+                             sweeps=sweeps, actuation_ab=actuation_ab)
     if host_only:
         result['host_only'] = True
     print(json.dumps(result))
@@ -1517,4 +1820,5 @@ async def main(host_only: bool = False, sharded_only: bool = False):
 if __name__ == '__main__':
     import sys
     asyncio.run(main(host_only='--host-only' in sys.argv[1:],
-                     sharded_only='--sharded-only' in sys.argv[1:]))
+                     sharded_only='--sharded-only' in sys.argv[1:],
+                     control_only='--control-only' in sys.argv[1:]))

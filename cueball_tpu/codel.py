@@ -19,6 +19,14 @@ from .utils import current_millis
 
 CODEL_INTERVAL = 100  # ms control interval (reference lib/codel.js:16)
 
+# Bounds on any EXTERNALLY-set target (the fleet control plane's
+# actuation path, parallel.control). The reference never mutates the
+# target after construction; set_target exists solely for that batched
+# path, and the guard keeps a wild decision column from ever driving
+# the target to 0 (drop everything) or unbounded (shed nothing).
+CODEL_TARGET_MIN = 1.0
+CODEL_TARGET_MAX = 60_000.0
+
 # Pacer cadence (ms) for the pool's continuous-evaluation shave-mode law.
 # Classic CoDel evaluates its control law at every dequeue of a busy
 # queue; a connection pool dequeues only when a connection is released,
@@ -50,6 +58,24 @@ class ControlledDelay:
         # event spans: (sojourn_ms, dropping_mode, drop_count).
         self.cd_last_sojourn = 0.0
         self.cd_last_decision: bool | None = None
+
+    def set_target(self, target_ms: float) -> None:
+        """Guarded external target set (control-plane actuation only).
+
+        Raises ValueError out of range; on success only the target
+        moves — the drop-law state (first_above/drop_next/count) is
+        carried, so a tightened target takes effect through the normal
+        interval machinery instead of causing a drop burst."""
+        if not isinstance(target_ms, (int, float)) or \
+                isinstance(target_ms, bool) or \
+                not math.isfinite(target_ms) or \
+                not CODEL_TARGET_MIN <= target_ms <= CODEL_TARGET_MAX:
+            raise ValueError(
+                'codel target must be in [%g, %g] ms, got %r'
+                % (CODEL_TARGET_MIN, CODEL_TARGET_MAX, target_ms))
+        self.cd_targdelay = float(target_ms)
+
+    setTarget = set_target
 
     def can_drop(self, now: float, start: float) -> bool:
         sojourn = now - start

@@ -13,6 +13,10 @@ that step: it samples every pool registered in the process-global
 monitor each LP tick and publishes the batched decisions.
 """
 
+from .control import (ControlInputs, ControlState, apply_decisions,
+                      control_init, control_inputs, control_step,
+                      make_control_step, make_shardmap_control_step,
+                      reduce_control)
 from .sampler import FleetSampler
 from .telemetry import (FleetInputs, FleetState, fleet_init,
                         fleet_inputs, fleet_scan, fleet_step,
@@ -20,8 +24,11 @@ from .telemetry import (FleetInputs, FleetState, fleet_init,
                         make_sharded_step, make_shardmap_step,
                         shard_inputs, shard_state, shard_window)
 
-__all__ = ['FleetInputs', 'FleetSampler', 'FleetState', 'fleet_init',
-           'fleet_inputs', 'fleet_scan', 'fleet_step',
-           'make_live_step', 'make_sharded_scan', 'make_sharded_step',
-           'make_shardmap_step', 'shard_inputs', 'shard_state',
-           'shard_window']
+__all__ = ['ControlInputs', 'ControlState', 'FleetInputs',
+           'FleetSampler', 'FleetState', 'apply_decisions',
+           'control_init', 'control_inputs', 'control_step',
+           'fleet_init', 'fleet_inputs', 'fleet_scan', 'fleet_step',
+           'make_control_step', 'make_live_step', 'make_sharded_scan',
+           'make_sharded_step', 'make_shardmap_control_step',
+           'make_shardmap_step', 'reduce_control', 'shard_inputs',
+           'shard_state', 'shard_window']

@@ -1,0 +1,403 @@
+"""Fleet control plane: one fused, sharded step that DECIDES.
+
+parallel.telemetry batches the framework's control laws but only
+*observes*: its outputs feed gauges and (opt-in) the rebalance shrink
+clamp. This module closes the loop. One jitted step consumes the
+telemetry columns already resident on device — the FleetInputs the
+sampler placed for the telemetry tick plus the telemetry step's own
+``filtered`` output, so at steady state the control step does zero
+extra host->device copies — and emits *decision columns*:
+
+- ``codel_target`` [P] f32: per-pool CoDel target adaptation (AIMD:
+  multiplicative tighten while the pool's head sojourn sits above its
+  plan, additive relax back toward the operator-configured target when
+  the fleet is quiet; 0.0 = no decision for that row);
+- ``plan_spares`` [P] i32: spares resize plan (one spare boosted under
+  fleet-wide pressure, shed again when idle and the filtered load sits
+  well below the setting);
+- ``plan_target`` [P] i32: the batched rebalance target-size plan (the
+  same LP-clamped law as telemetry._local_step, rounded);
+- ``delta`` [P] i32: backend rebalance delta, ``plan_target`` minus
+  the pool's current raw target — what the owning shard should add
+  (+) or may shed (-);
+- ``epoch`` scalar i32: the decision epoch, stamped into every apply
+  so stale columns can be rejected downstream.
+
+Sharding follows the HiCCL-style hierarchical decomposition the
+telemetry step established, but the layout here is derived from
+*regex partition rules* (:func:`match_partition_rules`, after the
+pjit partition-rule idiom): one rule table names which leaves are
+replicated scalars and which shard over the pools axis, and every
+entry point — GSPMD jit, shard_map, host placement — derives from it.
+On a 2-D ('host', 'chip') mesh the shard_map form reduces
+innermost-first (chip/ICI, then host/DCN).
+
+Bit-exact meshed-vs-plain decisions: every cross-pool reduction that
+FEEDS a decision is an int32 sum (active count, over-target count) or
+an f32 max — both order-independent — so the decision columns from the
+sharded step match the plain step bit for bit (tests/test_control.py
+soaks this at 100k rows). The published ``mean_load`` aggregate is a
+float sum and carries no such guarantee; it feeds gauges only.
+
+The carried :class:`ControlState` is donated through
+:func:`make_control_step`, so the adapted-target column is rewritten
+in place on device every step (double buffering handled by XLA).
+Actuation is host-side and batched: :func:`apply_decisions` walks the
+sampler's row->pool map and hands each pool its decision through
+``ConnectionPool.apply_control_decision`` — a guarded API that
+validates epoch and ranges BEFORE touching anything, marks the
+telemetry row dirty via the same TelemetryRowHandle hooks every other
+signal uses, and never touches pool FSM state on rejection.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+import typing
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..codel import CODEL_TARGET_MAX, CODEL_TARGET_MIN
+
+__all__ = ['ControlInputs', 'ControlState', 'apply_decisions',
+           'control_init', 'control_inputs', 'control_shardings',
+           'control_specs', 'control_step', 'make_control_step',
+           'make_shardmap_control_step', 'match_partition_rules',
+           'partition_rules', 'reduce_control', 'shard_control_inputs',
+           'shard_control_state']
+
+#: AIMD law constants. Tighten is multiplicative (x0.875 per over-target
+#: step, the classic fast back-off), relax is additive (+1 ms per quiet
+#: step) and capped at the pool's own configured target — the control
+#: plane only ever tightens CoDel relative to what the operator set.
+TIGHTEN_MULT = 0.875
+RELAX_STEP_MS = 1.0
+#: Fleet overload-fraction thresholds: above HOT the plan boosts one
+#: spare on over-target pools; below IDLE targets relax and an unused
+#: spare is shed.
+PRESSURE_HOT = 0.25
+PRESSURE_IDLE = 0.05
+
+
+class ControlState(typing.NamedTuple):
+    """Carried (donated) control-plane state."""
+    targets: jax.Array     # [P] adapted CoDel target (ms; 0 = none)
+    epoch: jax.Array       # scalar i32 decision epoch
+    now_ms: jax.Array      # scalar f32 clock of the last step
+
+
+class ControlInputs(typing.NamedTuple):
+    """One control tick's inputs (all [P] f32/bool except now_ms).
+
+    Deliberately a subset of the telemetry tick's device arrays plus
+    its ``filtered`` output: the sampler hands these over without any
+    further host->device transfer."""
+    samples: jax.Array         # busy + spares load sample
+    sojourns: jax.Array        # head-of-claim-queue sojourn (ms)
+    filtered: jax.Array        # FIR-filtered load (telemetry output)
+    target_delay: jax.Array    # configured CoDel target (+inf = off)
+    spares: jax.Array          # pool `spares` option
+    maximum: jax.Array         # pool `maximum` option
+    active: jax.Array          # bool: row occupied
+    reset: jax.Array           # bool: row newly (re)assigned
+    now_ms: jax.Array          # scalar clock (ms)
+
+
+def control_init(n_pools: int, epoch: int = 0) -> ControlState:
+    return ControlState(
+        targets=jnp.zeros((n_pools,), jnp.float32),
+        epoch=jnp.int32(epoch),
+        now_ms=jnp.float32(0.0))
+
+
+def control_inputs(n_pools: int, **kw) -> ControlInputs:
+    """A ControlInputs of idle defaults; override fields by keyword."""
+    z = jnp.zeros((n_pools,), jnp.float32)
+    vals = dict(
+        samples=z, sojourns=z, filtered=z,
+        target_delay=jnp.full((n_pools,), jnp.inf, jnp.float32),
+        spares=z, maximum=jnp.full((n_pools,), 16.0, jnp.float32),
+        active=jnp.zeros((n_pools,), bool),
+        reset=jnp.zeros((n_pools,), bool),
+        now_ms=jnp.float32(0.0))
+    vals.update(kw)
+    return ControlInputs(**{k: jnp.asarray(v) for k, v in vals.items()})
+
+
+# -- the law ----------------------------------------------------------------
+
+def _plan_local(state: ControlState, inp: ControlInputs):
+    """Per-pool pre-reduction work: resolve the carried adapted target
+    and flag over-target rows. Elementwise, so identical on a shard."""
+    base = jnp.where(
+        jnp.isfinite(inp.target_delay)
+        & (inp.target_delay >= CODEL_TARGET_MIN),
+        jnp.minimum(inp.target_delay, CODEL_TARGET_MAX), 0.0)
+    has_codel = base > 0.0
+    cur = jnp.where(inp.reset | (state.targets <= 0.0),
+                    base, state.targets)
+    cur = jnp.where(has_codel, cur, 0.0)
+    over = inp.active & has_codel & (inp.sojourns > cur)
+    return base, cur, over
+
+
+def _control_sums(inp: ControlInputs, over) -> dict:
+    """Shard-local reduction terms. Everything a DECISION depends on is
+    an int32 sum or a max, so the cross-shard combine is bit-exact
+    regardless of reduction order; 'load' (float) feeds gauges only."""
+    act = inp.active
+    return {
+        'n': jnp.sum(act.astype(jnp.int32)),
+        'n_over': jnp.sum(over.astype(jnp.int32)),
+        'load': jnp.sum(jnp.where(act, inp.samples, 0.0)),
+        'max_sojourn': jnp.max(jnp.where(act, inp.sojourns, 0.0)),
+    }
+
+
+def _decide(state: ControlState, inp: ControlInputs,
+            base, cur, over, sums: dict):
+    """Post-reduction elementwise decisions. `sums` holds the fleet
+    totals (already combined across shards in the sharded forms)."""
+    n = jnp.maximum(sums['n'], 1)
+    pressure = sums['n_over'].astype(jnp.float32) / n.astype(jnp.float32)
+    quiet = pressure < PRESSURE_IDLE
+    has_codel = base > 0.0
+
+    # CoDel target AIMD, quantized to integer ms so reduction noise
+    # can never flip a decision: tighten while over, relax when this
+    # pool is below target AND the fleet as a whole is quiet.
+    tighten = over
+    relax = inp.active & has_codel & ~over & quiet
+    t = jnp.where(tighten, jnp.floor(cur * TIGHTEN_MULT), cur)
+    t = jnp.where(relax, t + RELAX_STEP_MS, t)
+    t = jnp.clip(jnp.round(t), CODEL_TARGET_MIN, base)
+    t = jnp.where(inp.active & has_codel, t, 0.0)
+
+    # Resize plans. plan_target is the telemetry rebalance law
+    # (LP-clamped shrink), rounded to a whole connection count.
+    raw = inp.samples + inp.spares
+    lp_min = jnp.ceil(inp.filtered)
+    plan = jnp.where(raw < lp_min * 1.05, lp_min, raw)
+    plan = jnp.minimum(plan, inp.maximum)
+    plan_target = jnp.round(plan).astype(jnp.int32)
+    hot = pressure >= PRESSURE_HOT
+    boost = jnp.where(hot & over, 1.0, 0.0)
+    shed = jnp.where(quiet & (inp.filtered + 1.0 < inp.spares), 1.0, 0.0)
+    plan_spares = jnp.clip(jnp.round(inp.spares + boost - shed),
+                           0.0, inp.maximum).astype(jnp.int32)
+    delta = plan_target - jnp.round(raw).astype(jnp.int32)
+
+    epoch = state.epoch + jnp.int32(1)
+    new_state = ControlState(targets=t, epoch=epoch, now_ms=inp.now_ms)
+    decisions = {
+        'codel_target': t,
+        'plan_spares': plan_spares,
+        'plan_target': plan_target,
+        'delta': delta,
+        'epoch': epoch,
+    }
+    fleet = {
+        'n_pools': sums['n'].astype(jnp.float32),
+        'pressure': pressure,
+        'mean_load': sums['load'] / n.astype(jnp.float32),
+        'max_sojourn': sums['max_sojourn'],
+    }
+    return new_state, decisions, fleet
+
+
+def _step(state: ControlState, inp: ControlInputs):
+    """The fused single-program control step (plain / GSPMD form)."""
+    base, cur, over = _plan_local(state, inp)
+    sums = _control_sums(inp, over)
+    return _decide(state, inp, base, cur, over, sums)
+
+
+#: One fused control tick for the whole fleet (single-device or GSPMD).
+#: Returns (new_state, decision_columns, fleet_aggregates).
+control_step = jax.jit(_step)
+
+
+# -- regex partition rules --------------------------------------------------
+
+def _path_str(path) -> str:
+    """'/'-joined tree path: NamedTuple fields and dict keys by name."""
+    parts = []
+    for k in path:
+        if hasattr(k, 'name'):
+            parts.append(str(k.name))
+        elif hasattr(k, 'key'):
+            parts.append(str(k.key))
+        elif hasattr(k, 'idx'):
+            parts.append(str(k.idx))
+        else:                                      # pragma: no cover
+            parts.append(str(k))
+    return '/'.join(parts)
+
+
+def match_partition_rules(rules, tree):
+    """Map a rule table of ``(regex, PartitionSpec)`` pairs over a
+    pytree of abstract leaves, yielding the PartitionSpec tree. First
+    matching rule wins (re.search over the '/'-joined leaf path);
+    rank-0 leaves are never partitioned; an unmatched leaf raises, so
+    a new state/decision column must be placed deliberately."""
+    def pick(path, leaf):
+        if len(getattr(leaf, 'shape', ())) == 0:
+            return P()
+        name = _path_str(path)
+        for rx, spec in rules:
+            if re.search(rx, name):
+                return spec
+        raise ValueError('no partition rule matches %r' % name)
+    return jax.tree_util.tree_map_with_path(pick, tree)
+
+
+def partition_rules(axes: tuple = ('pools',)):
+    """The ONE enumeration of how control-plane data shards: scalars
+    (clock, epoch, fleet aggregates) replicate; every per-pool column
+    shards over the mesh axes."""
+    return (
+        (r'(^|/)(now_ms|epoch|n_pools|pressure|mean_load|max_sojourn)$',
+         P()),
+        (r'.*', P(axes)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def control_specs(axes: tuple = ('pools',)):
+    """(state, inputs, outputs) PartitionSpec trees, derived by running
+    the rule table over abstract templates of the step."""
+    rules = partition_rules(axes)
+    state_t = jax.eval_shape(lambda: control_init(8))
+    inp_t = jax.eval_shape(lambda: control_inputs(8))
+    out_t = jax.eval_shape(_step, state_t, inp_t)
+    return (match_partition_rules(rules, state_t),
+            match_partition_rules(rules, inp_t),
+            match_partition_rules(rules, out_t))
+
+
+def control_shardings(mesh: Mesh, axes: tuple = ('pools',)):
+    """control_specs bound to a mesh as NamedShardings."""
+    place = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    return tuple(jax.tree.map(place, t, is_leaf=lambda x:
+                              isinstance(x, P))
+                 for t in control_specs(axes))
+
+
+@functools.lru_cache(maxsize=None)
+def make_control_step(mesh: Mesh | None = None,
+                      axes: tuple = ('pools',)):
+    """The live control step: jitted, carried state DONATED, and (with
+    a mesh) every per-pool column sharded per the regex rules, so the
+    fleet counts compile to hierarchical all-reduces. Do not reuse a
+    ControlState after passing it here — donation invalidates it.
+    Memoized per (mesh, axes) like telemetry.make_live_step."""
+    if mesh is None:
+        return jax.jit(_step, donate_argnums=0)
+    state_sh, inp_sh, out_sh = control_shardings(mesh, axes)
+    return jax.jit(_step, in_shardings=(state_sh, inp_sh),
+                   out_shardings=out_sh, donate_argnums=0)
+
+
+def make_shardmap_control_step(mesh: Mesh, axes: tuple = ('pools',)):
+    """SPMD form with hand-written collectives: per-pool law on the
+    local shard, the decision-feeding counts reduced innermost mesh
+    axis first (chip/ICI) then outermost (host/DCN) — the hierarchical
+    all-reduce. Decision columns are asserted identical to the plain
+    step (int/max reductions are order-independent)."""
+    try:
+        from jax import shard_map              # jax >= 0.8
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    state_specs, inp_specs, out_specs = control_specs(axes)
+
+    def _reduce(v, op):
+        for ax in reversed(axes):
+            v = op(v, ax)
+        return v
+
+    def local(state, inp):
+        base, cur, over = _plan_local(state, inp)
+        sums = _control_sums(inp, over)
+        sums = {k: (_reduce(v, jax.lax.pmax) if k == 'max_sojourn'
+                    else _reduce(v, jax.lax.psum))
+                for k, v in sums.items()}
+        return _decide(state, inp, base, cur, over, sums)
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(state_specs, inp_specs),
+        out_specs=out_specs))
+
+
+def shard_control_state(state: ControlState, mesh: Mesh,
+                        axes: tuple = ('pools',)) -> ControlState:
+    state_sh, _, _ = control_shardings(mesh, axes)
+    return jax.tree.map(jax.device_put, state, state_sh)
+
+
+def shard_control_inputs(inp: ControlInputs, mesh: Mesh,
+                         axes: tuple = ('pools',)) -> ControlInputs:
+    _, inp_sh, _ = control_shardings(mesh, axes)
+    return jax.tree.map(jax.device_put, inp, inp_sh)
+
+
+# -- batched host actuation -------------------------------------------------
+
+def apply_decisions(pools_by_row, decisions, at_ms=None) -> dict:
+    """Apply one step's decision columns to live pools.
+
+    ``pools_by_row`` maps row index -> pool (the sampler's
+    ``fs_row_pool``); ``decisions`` is the step's decision dict (device
+    or host arrays). Every pool is offered its row's decision through
+    ``apply_control_decision`` — the guarded API that validates the
+    epoch and every field BEFORE mutating anything — and flags its own
+    telemetry row dirty on accept, so the next tick re-gathers exactly
+    the rows that moved. Pools without the API are skipped. Returns
+    ``{'applied': n, 'rejected': n, 'skipped': n, 'epoch': e}``."""
+    import numpy as np
+    ct = np.asarray(decisions['codel_target'])
+    sp = np.asarray(decisions['plan_spares'])
+    epoch = int(decisions['epoch'])
+    applied = rejected = skipped = 0
+    for row, pool in pools_by_row.items():
+        apply = getattr(pool, 'apply_control_decision', None)
+        if apply is None:
+            skipped += 1
+            continue
+        target = float(ct[row])
+        ok = apply(epoch,
+                   codel_target=target if target > 0.0 else None,
+                   spares=int(sp[row]), at_ms=at_ms)
+        if ok:
+            applied += 1
+        else:
+            rejected += 1
+    return {'applied': applied, 'rejected': rejected,
+            'skipped': skipped, 'epoch': epoch}
+
+
+def reduce_control(records) -> dict:
+    """Combine per-shard control summaries (record['control'] dicts)
+    into one fleet row: counts sum, pressure/mean_load combine weighted
+    by pool count, max_sojourn takes the worst shard."""
+    records = [r for r in records if r]
+    out = {'n_pools': 0.0, 'pressure': 0.0, 'mean_load': 0.0,
+           'max_sojourn': 0.0, 'applied': 0, 'rejected': 0,
+           'skipped': 0}
+    if not records:
+        return out
+    tot = sum(float(r['fleet']['n_pools']) for r in records)
+    safe = tot if tot > 0.0 else 1.0
+    for r in records:
+        f = r['fleet']
+        w = float(f['n_pools'])
+        out['n_pools'] += w
+        out['pressure'] += f['pressure'] * w / safe
+        out['mean_load'] += f['mean_load'] * w / safe
+        out['max_sojourn'] = max(out['max_sojourn'], f['max_sojourn'])
+        for k in ('applied', 'rejected', 'skipped'):
+            out[k] += int(r.get(k, 0))
+    return out

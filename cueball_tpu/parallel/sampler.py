@@ -67,6 +67,15 @@ SAMPLER_INT = 200  # ms, the pools' own LP cadence (lib/pool.js:251)
 EPOCH_LIMIT = float(2 ** 20)
 EPOCH_MARGIN = 1000.0
 
+_CONTROL_GAUGES = {
+    'pressure': 'fleet overload fraction seen by the control step',
+    'mean_load': 'mean busy+spares load seen by the control step',
+    'applied': 'control decisions accepted by pools last step',
+    'rejected': 'control decisions rejected by pools last step',
+    'epoch': 'decision epoch of the last control step',
+    'step_ms': 'host-side duration of the last control step (ms)',
+}
+
 _FLEET_GAUGES = {
     'n_pools': 'pools currently sampled into the fleet step',
     'mean_load': 'mean busy+spares load across the fleet',
@@ -202,6 +211,16 @@ class FleetSampler:
       pool) and the published ``cueball_fleet_*`` gauges carry a
       ``shard`` label. One such sampler runs per shard loop; the
       router reduces their fleet rows with :func:`reduce_fleet`.
+    - control: run the fused control step (parallel.control) after
+      every telemetry tick and OFFER its decision columns to the
+      sampled pools through the guarded ``apply_control_decision``
+      API. Default OFF. The step consumes the telemetry tick's device
+      arrays directly (zero extra host->device copies); a pool only
+      *accepts* decisions if it was constructed with
+      controlActuation=True — both ends opt in, like `actuate`. Rows
+      inside the FIR warm-up window (< taps ticks) are not offered
+      decisions. The tick record gains a ``control`` entry with the
+      fleet row, apply counts and the decision columns.
     """
 
     def __init__(self, options: dict | None = None):
@@ -224,6 +243,10 @@ class FleetSampler:
         self.fs_step = None                    # jitted tick step (lazy)
         self.fs_input_shardings = None         # FleetInputs of shardings
         self.fs_input_cache: dict[str, tuple] = {}  # field -> (host, dev)
+        self.fs_control = bool(options.get('control'))
+        self.fs_ctrl_state = None              # ControlState (lazy)
+        self.fs_ctrl_step = None               # jitted control step
+        self.fs_ctrl_last: dict | None = None  # last control record
 
         self.fs_epoch = mod_utils.current_millis()
         self.fs_rows: dict[str, int] = {}      # pool uuid -> row
@@ -321,6 +344,16 @@ class FleetSampler:
         if self.fs_mesh is not None:
             self.fs_state = shard_state(
                 self.fs_state, self.fs_mesh, self.fs_mesh_axes)
+        if self.fs_ctrl_state is not None:
+            from .control import ControlState, shard_control_state
+            cs = ControlState(
+                targets=jnp.pad(self.fs_ctrl_state.targets, (0, pad)),
+                epoch=self.fs_ctrl_state.epoch,
+                now_ms=self.fs_ctrl_state.now_ms)
+            if self.fs_mesh is not None:
+                cs = shard_control_state(cs, self.fs_mesh,
+                                         self.fs_mesh_axes)
+            self.fs_ctrl_state = cs
         self.fs_input_cache.clear()   # shapes changed
         for name, arr in self.fs_cols.items():
             grown = np.full((cap,), _COL_DEFAULTS[name], np.float32)
@@ -597,21 +630,22 @@ class FleetSampler:
         fleet_np = {k: float(v) for k, v in fleet.items()}
         out_np = {k: np.asarray(v) for k, v in out.items()}
         per_pool = _TickPools(dict(self.fs_rows), arrays, out_np)
+        # Per-row tick counters drive the actuation warm-up gates (both
+        # the advisory push and the control step below): a row's filter
+        # starts zeroed on (re)assign, so for the first `taps` ticks
+        # its output under-reads the history the pool's own converged
+        # filter still holds — pushing it would collapse the shrink
+        # clamp after a sampler restart. Only a fully-populated window
+        # (which by the parity laws equals the per-pool filter fed the
+        # same samples) is advisory-grade.
+        for row in self.fs_row_pool:
+            self.fs_row_ticks[row] = self.fs_row_ticks.get(row, 0) + 1
         if self.fs_actuate:
             # Close the loop: hand each pool its batched decision.
             # The pool stores it unconditionally but consults it only
             # under its own fleetActuation flag (+freshness TTL).
-            # Warm-up gate: a row's filter starts zeroed on (re)assign,
-            # so for the first `taps` ticks its output under-reads the
-            # history the pool's own converged filter still holds —
-            # pushing it would collapse the shrink clamp after a
-            # sampler restart. Only a fully-populated window (which by
-            # the parity laws equals the per-pool filter fed the same
-            # samples) is advisory-grade.
             for row, pool in self.fs_row_pool.items():
-                ticks = self.fs_row_ticks.get(row, 0) + 1
-                self.fs_row_ticks[row] = ticks
-                if ticks < self.fs_taps:
+                if self.fs_row_ticks.get(row, 0) < self.fs_taps:
                     continue
                 receive = getattr(pool, 'receive_fleet_advisory', None)
                 if receive is not None:
@@ -619,6 +653,8 @@ class FleetSampler:
 
         record = {'tick': self.fs_ticks, 'now_ms': now,
                   'fleet': fleet_np, 'pools': per_pool}
+        if self.fs_control:
+            record['control'] = self._control_once(inp, out, abs_now)
         if self.fs_record:
             # History must be plain data — a lazy view per retained
             # tick would pin every tick's column copies anyway, and
@@ -643,6 +679,79 @@ class FleetSampler:
                         fleet_np[name], labels)
         return record
 
+    # -- control plane ---------------------------------------------------
+
+    def _ensure_control(self):
+        from .control import (control_init, make_control_step,
+                              shard_control_state)
+        if self.fs_ctrl_state is None:
+            self.fs_ctrl_state = control_init(self.fs_capacity)
+            if self.fs_mesh is not None:
+                self.fs_ctrl_state = shard_control_state(
+                    self.fs_ctrl_state, self.fs_mesh, self.fs_mesh_axes)
+            # Carried control state is donated through the step, same
+            # double-buffer contract as the telemetry state.
+            self.fs_ctrl_step = make_control_step(self.fs_mesh,
+                                                  self.fs_mesh_axes)
+        return self.fs_ctrl_state
+
+    def _control_once(self, inp, out, abs_now: float) -> dict:
+        """Run the fused control step on the telemetry tick's device
+        arrays and offer the decision columns to the sampled pools.
+
+        Zero extra host->device copies: every ControlInputs field is
+        either a FleetInputs array the tick already placed or the
+        telemetry step's own ``filtered`` output. Only the decision
+        columns come back to host (they must — actuation is a host
+        concern)."""
+        import numpy as np
+        from .control import ControlInputs, apply_decisions
+        t0 = mod_utils.current_millis()
+        state = self._ensure_control()
+        cinp = ControlInputs(
+            samples=inp.samples, sojourns=inp.sojourns,
+            filtered=out['filtered'], target_delay=inp.target_delay,
+            spares=inp.spares, maximum=inp.maximum,
+            active=inp.active, reset=inp.reset, now_ms=inp.now_ms)
+        try:
+            new_state, decisions, fleet = self.fs_ctrl_step(state, cinp)
+        except Exception:
+            # Same recovery as the telemetry step: donation already
+            # invalidated the carried buffers, so drop the state and
+            # re-init (epoch restarts; pools re-trust it after
+            # CONTROL_EPOCH_TTL).
+            self.fs_ctrl_state = None
+            raise
+        self.fs_ctrl_state = new_state
+        dec_np = {k: np.asarray(v) for k, v in decisions.items()}
+        fleet_np = {k: float(v) for k, v in fleet.items()}
+        # Warm-up gate: only rows whose FIR window is fully populated
+        # are offered decisions (same reasoning as the advisory push).
+        eligible = {row: pool
+                    for row, pool in self.fs_row_pool.items()
+                    if self.fs_row_ticks.get(row, 0) >= self.fs_taps}
+        summary = apply_decisions(eligible, dec_np, at_ms=abs_now)
+        record = {'fleet': fleet_np, 'decisions': dec_np,
+                  'step_ms': mod_utils.current_millis() - t0}
+        record.update(summary)
+        self.fs_ctrl_last = record
+        collector = self.fs_collector
+        if collector is None:
+            collector = mod_trace.active_collector()
+        if collector is not None:
+            labels = ({'shard': str(self.fs_shard)}
+                      if self.fs_shard is not None else None)
+            vals = {'pressure': fleet_np['pressure'],
+                    'mean_load': fleet_np['mean_load'],
+                    'applied': summary['applied'],
+                    'rejected': summary['rejected'],
+                    'epoch': summary['epoch'],
+                    'step_ms': record['step_ms']}
+            for name, help_ in _CONTROL_GAUGES.items():
+                collector.gauge('cueball_control_' + name, help_).set(
+                    float(vals[name]), labels)
+        return record
+
     # -- kang integration ------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -661,6 +770,19 @@ class FleetSampler:
             # (http_server serializes unknown mappings as repr).
             latest = dict(latest)
             latest['pools'] = dict(latest['pools'])
+        control = None
+        if self.fs_control:
+            last = self.fs_ctrl_last
+            control = {
+                'enabled': True,
+                'last': None if last is None else {
+                    'fleet': last['fleet'], 'epoch': last['epoch'],
+                    'applied': last['applied'],
+                    'rejected': last['rejected'],
+                    'skipped': last['skipped'],
+                    'step_ms': last['step_ms'],
+                },
+            }
         return {
             'interval_ms': self.fs_interval,
             'shard': self.fs_shard,
@@ -668,6 +790,7 @@ class FleetSampler:
             'ticks': self.fs_ticks,
             'rows': dict(self.fs_rows),
             'actuate': self.fs_actuate,
+            'control': control,
             'mesh': mesh,
             'row_ticks': dict(self.fs_row_ticks),
             'last_tick_visits': self.fs_tick_visits,

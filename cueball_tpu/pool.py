@@ -53,6 +53,12 @@ CODEL_PACE = mod_codel.CODEL_PACE
 # pool's own filter governs again.
 FLEET_ADVISORY_TTL = 1000
 
+# How long (ms) after the last accepted control decision a LOWER epoch
+# is still treated as stale. A restarted sampler's epoch counter
+# restarts from 1; once this window has passed with no decisions, the
+# pool trusts the new counter instead of rejecting it forever.
+CONTROL_EPOCH_TTL = 5000
+
 
 def gen_taps(count: int, tc: float) -> list[float]:
     """Generate normalized EMA filter taps (reference lib/pool.js:50-76).
@@ -266,6 +272,18 @@ class ConnectionPool(FSM):
         self.p_fleet_actuation = bool(options.get('fleetActuation'))
         self.p_fleet_advisory: tuple[float, float] | None = None
 
+        # Control-plane actuation (opt-in, default OFF): when enabled,
+        # a FleetSampler running the fused control step
+        # (parallel.control) may push whole decisions — adapted CoDel
+        # target + spares plan — through apply_control_decision. Both
+        # ends opt in, same contract as fleetActuation: the sampler
+        # offers decisions to every row, the pool accepts only under
+        # this flag. p_ctrl_epoch/p_ctrl_at implement the stale-epoch
+        # guard (see apply_control_decision).
+        self.p_control_actuation = bool(options.get('controlActuation'))
+        self.p_ctrl_epoch = 0
+        self.p_ctrl_at = -math.inf
+
         # Fleet-telemetry push handles (see FleetSampler): a tuple so
         # the per-event dirty mark is a plain iteration — empty for the
         # (default) unsampled pool, one entry per attached sampler.
@@ -346,6 +364,65 @@ class ConnectionPool(FSM):
         self.rebalance()
 
     setMaximum = set_maximum
+
+    def apply_control_decision(self, epoch: int, codel_target=None,
+                               spares=None, at_ms=None) -> bool:
+        """Guarded control-plane actuation: accept one decision row
+        from the fused control step (parallel.control).
+
+        The whole decision is validated BEFORE anything mutates —
+        rejection (returns False) leaves the pool, its CoDel state and
+        its FSM untouched:
+
+        - the pool must have opted in (``controlActuation`` option);
+        - ``epoch`` must be a fresh int: strictly greater than the
+          last applied epoch, unless the last apply is older than
+          CONTROL_EPOCH_TTL (a restarted sampler's counter restarts;
+          after the TTL its decisions are trusted again);
+        - ``codel_target`` (when given) needs a live ControlledDelay
+          and must sit within [CODEL_TARGET_MIN, CODEL_TARGET_MAX];
+        - ``spares`` (when given) must be an int in [0, maximum].
+
+        On accept, only the values that actually moved are applied:
+        the CoDel target via the guarded ``set_target`` and the spares
+        setting via the same dirty-mark + rebalance path as
+        ``set_spares``. Cost when the control plane is idle: zero —
+        nothing on the claim path reads any of this."""
+        if not self.p_control_actuation:
+            return False
+        now = at_ms if at_ms is not None else mod_utils.current_millis()
+        if not isinstance(epoch, int) or isinstance(epoch, bool):
+            return False
+        stale_ok = now - self.p_ctrl_at > CONTROL_EPOCH_TTL
+        if epoch <= self.p_ctrl_epoch and not stale_ok:
+            return False
+        if codel_target is not None:
+            if self.p_codel is None:
+                return False
+            if not isinstance(codel_target, (int, float)) or \
+                    isinstance(codel_target, bool) or \
+                    not math.isfinite(codel_target) or \
+                    not (mod_codel.CODEL_TARGET_MIN <= codel_target
+                         <= mod_codel.CODEL_TARGET_MAX):
+                return False
+        if spares is not None:
+            if not isinstance(spares, int) or isinstance(spares, bool) \
+                    or spares < 0 or spares > self.p_max:
+                return False
+        # Validation complete; apply.
+        self.p_ctrl_epoch = epoch
+        self.p_ctrl_at = now
+        if codel_target is not None and \
+                codel_target != self.p_codel.cd_targdelay:
+            self.p_codel.set_target(codel_target)
+            self._telemetry_dirty()
+        if spares is not None and spares != self.p_spares:
+            self.p_spares = spares
+            self._telemetry_dirty()
+            self.rebalance()
+        return True
+
+    applyControlDecision = apply_control_decision
 
     def _shrink_floor(self) -> float:
         """The low-pass load figure the shrink clamp uses: the fleet

@@ -444,6 +444,42 @@ class FleetRouter:
             self.fr_samplers[shard_id] = sampler
         return sampler.sample_once()
 
+    def _control_shard(self, shard_id: int):
+        # Runs inside the shard loop (via run_on): the shard's sampler
+        # gains the control plane if it didn't have it, ticks once, and
+        # the actuation — apply_control_decision on each owned pool,
+        # which marks telemetry rows dirty — happens right here on the
+        # loop that owns those pools, never cross-thread.
+        sampler = self.fr_samplers.get(shard_id)
+        if sampler is None:
+            from ..parallel.sampler import FleetSampler
+            sampler = FleetSampler({'shard': shard_id, 'control': True})
+            self.fr_samplers[shard_id] = sampler
+        else:
+            sampler.fs_control = True
+        rec = sampler.sample_once()
+        return rec.get('control') if rec else None
+
+    async def control_fleet(self):
+        """One control-plane pass: each running shard runs the fused
+        control step over its own pools ON ITS OWN LOOP (via run_on)
+        and applies the decision columns there; the per-shard summaries
+        reduce shard->host. Not offered for the spawn backend (children
+        run their own samplers)."""
+        if self.fr_backend == 'spawn':
+            raise CueBallError(
+                'control_fleet is not available on the spawn backend; '
+                'children run their own control planes')
+        records = []
+        for sid, fsm in sorted(self.fr_fsms.items()):
+            if not fsm.is_in_state('running'):
+                continue
+            rec = await self.run_on(sid, self._control_shard, sid)
+            if rec:
+                records.append(rec)
+        from ..parallel.control import reduce_control
+        return reduce_control(records)
+
     async def sample_fleet(self, mesh=None, mesh_axes=('host', 'chip')):
         """One per-shard FleetSampler pass each on its own loop, then
         the shard->host reduction (and host->mesh when ``mesh`` is

@@ -157,6 +157,18 @@ def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
                 'arms': {'1': {'aggregate_median': 49.0},
                          '8': {'aggregate_median': 50.0}}}
 
+    async def fake_actuation_ab():
+        return {'off_pre_ops_per_sec': 100.0, 'on_ops_per_sec': 99.6,
+                'off_post_ops_per_sec': 100.0,
+                'actuation_on_overhead_pct': 0.4}
+
+    def fake_sweeps(sizes=None):
+        return {'telemetry_pools_per_sec_sweep':
+                {'10240': 2000.0, '102400': 3000.0},
+                'control_step_pools_per_sec':
+                {'10240': 5000.0, '102400': 7000.0},
+                'backend': 'cpu'}
+
     def boom(*a, **kw):
         raise AssertionError('chip stage must not run under host_only')
 
@@ -166,6 +178,8 @@ def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
                         fake_queued)
     monkeypatch.setattr(bench, 'bench_tracing_ab', fake_tracing_ab)
     monkeypatch.setattr(bench, 'bench_pump_ab', fake_pump_ab)
+    monkeypatch.setattr(bench, 'bench_actuation_ab', fake_actuation_ab)
+    monkeypatch.setattr(bench, 'bench_fleet_sweeps_host', fake_sweeps)
     monkeypatch.setattr(bench, 'bench_sharded_claims_guarded',
                         fake_sharded)
     monkeypatch.setattr(bench, 'bench_sampler_tick_host',
@@ -198,10 +212,21 @@ def test_main_host_only_skips_chip_and_prints_json(monkeypatch, capsys):
     assert abs(result['claim_sharded_k1_vs_queued_pct'] - (-2.0)) < 0.01
     assert result['claim_release_median_ops_per_sec'] == 100.0
     assert result['claim_release_spread_pct'] == 0.0
-    assert result['telemetry_pools_per_sec'] is None
     assert 'telemetry_error' not in result
-    # The probe outcome explains the null chip fields in-band.
+    # The probe outcome explains the chip fields in-band.
     assert result['chip_probe']['outcome'] == 'cpu-only'
+    # Never-silently-null rule: with no chip child the sweep columns
+    # and the headline telemetry rate come from the host CPU copy,
+    # labelled with the backend that produced them.
+    assert result['control_step_pools_per_sec'] == \
+        {'10240': 5000.0, '102400': 7000.0}
+    assert result['telemetry_pools_per_sec_sweep'] == \
+        {'10240': 2000.0, '102400': 3000.0}
+    assert result['telemetry_pools_per_sec'] == 3000.0
+    assert result['telemetry_backend'] == 'cpu'
+    assert result['control_step_backend'] == 'cpu'
+    assert result['claim_actuation_ab'][
+        'actuation_on_overhead_pct'] == 0.4
 
 
 def test_tracing_off_overhead_within_noise():
@@ -337,6 +362,88 @@ def test_committed_round_trial_spread_within_budget():
         '25%% budget the warm-state settle is meant to hold' % (
             name, parsed['claim_release_spread_pct'],
             parsed.get('claim_release_trials')))
+
+
+def _all_rounds():
+    import glob
+    import re
+    root = os.path.dirname(os.path.abspath(bench.__file__))
+    rounds = [p for p in glob.glob(os.path.join(root, 'BENCH_r*.json'))
+              if re.fullmatch(r'BENCH_r\d+\.json', os.path.basename(p))]
+    rounds.sort(key=lambda p: int(
+        re.search(r'r(\d+)', os.path.basename(p)).group(1)))
+    out = []
+    for p in rounds:
+        with open(p, encoding='utf-8') as f:
+            out.append((os.path.basename(p),
+                        json.load(f).get('parsed') or {}))
+    return out
+
+
+def test_committed_round_control_columns_not_null():
+    """ISSUE 9 gate: the latest round must carry a non-null
+    `telemetry_pools_per_sec` (every such field in r06..r08 was null)
+    and a `control_step_pools_per_sec` sweep with a >=100k-pool arm.
+    Rounds captured before the control plane landed are exempt."""
+    name, parsed = _latest_round()
+    if 'control_step_pools_per_sec' not in parsed:
+        pytest.skip('%s predates the control plane' % name)
+    assert parsed.get('telemetry_pools_per_sec'), (
+        '%s records a null telemetry_pools_per_sec: the host CPU '
+        'fallback sweep exists precisely so this is never null' % name)
+    sweep = parsed['control_step_pools_per_sec']
+    assert sweep, '%s records a null control_step sweep' % name
+    assert all(v for v in sweep.values()), (
+        '%s has a null control_step arm: %s' % (name, sweep))
+    assert any(int(k) >= 100_000 for k in sweep), (
+        '%s control_step sweep has no >=100k-pool arm: %s'
+        % (name, sorted(sweep)))
+    # The round says which backend produced the decision rate, and
+    # which measured-path code the capture ran under.
+    assert parsed.get('control_step_backend')
+    assert parsed.get('telemetry_code_hash')
+
+
+def test_committed_round_actuation_hooks_within_budget():
+    """ISSUE 9 acceptance: with the control plane idle, the actuation
+    hooks cost <= 1% on the claim hot path (median of per-round paired
+    deltas; the A/B interleaving cancels host drift). Rounds captured
+    before the actuation A/B landed are exempt."""
+    name, parsed = _latest_round()
+    ab = parsed.get('claim_actuation_ab')
+    if ab is None:
+        pytest.skip('%s predates the actuation A/B' % name)
+    assert ab['actuation_on_overhead_pct'] <= 1.0, (
+        '%s records actuation_on_overhead_pct=%s: the idle control '
+        'plane budget is 1%%' % (name, ab['actuation_on_overhead_pct']))
+
+
+def test_committed_round_control_step_no_regression():
+    """The control step's pools/sec must not regress >10% against the
+    previous committed round measured on the same backend (the ISSUE 9
+    perf gate). Compared arm by arm on the arms both rounds share;
+    rounds before the control plane, or a backend change (cpu fallback
+    one round, chip capture the next), make the comparison
+    meaningless and skip."""
+    rounds = [(n, p) for n, p in _all_rounds()
+              if p.get('control_step_pools_per_sec')]
+    if len(rounds) < 2:
+        pytest.skip('fewer than two rounds carry the control sweep')
+    (prev_name, prev), (name, cur) = rounds[-2], rounds[-1]
+    if prev.get('control_step_backend') != \
+            cur.get('control_step_backend'):
+        pytest.skip('backend changed between %s and %s'
+                    % (prev_name, name))
+    prev_sweep = prev['control_step_pools_per_sec']
+    cur_sweep = cur['control_step_pools_per_sec']
+    shared = sorted(set(prev_sweep) & set(cur_sweep), key=int)
+    assert shared, 'no shared sweep arms between %s and %s' % (
+        prev_name, name)
+    for arm in shared:
+        assert cur_sweep[arm] >= 0.9 * prev_sweep[arm], (
+            '%s control_step_pools_per_sec[%s]=%s regressed >10%% vs '
+            '%s (%s)' % (name, arm, cur_sweep[arm], prev_name,
+                         prev_sweep[arm]))
 
 
 def test_committed_round_sharded_scaling():

@@ -1,0 +1,301 @@
+"""parallel.control: the fused control step and its actuation edge.
+
+Three concerns, locked separately:
+
+- the guarded actuation API (`ConnectionPool.apply_control_decision`)
+  rejects malformed decisions ATOMICALLY — an out-of-range target,
+  a stale epoch, a bad spares count leave the pool, its CoDel state
+  and its FSM exactly as they were;
+- the partition-rule plumbing (`match_partition_rules` and the rule
+  table) places every control column deliberately;
+- the sharded forms are BIT-EXACT: the plain jitted step, the
+  GSPMD-sharded step and the hand-collective shard_map step produce
+  identical decision columns over a 100k-row fleet soak (conftest
+  forces 8 virtual CPU devices, so the real all-reduce paths run).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from cueball_tpu import codel as mod_codel
+from cueball_tpu import pool as mod_pool
+from cueball_tpu.parallel import control as ctl
+
+from conftest import run_async, settle
+from test_pool import Ctx, make_pool
+
+
+# -- guarded actuation ------------------------------------------------------
+
+def snap(pool):
+    """Everything a rejected decision must not touch."""
+    return (pool.get_state(), pool.p_spares, pool.p_ctrl_epoch,
+            pool.p_ctrl_at,
+            pool.p_codel.cd_targdelay if pool.p_codel else None)
+
+
+def actuation_pool(ctx, **opts):
+    return make_pool(ctx, spares=2, maximum=8,
+                     targetClaimDelay=400.0, controlActuation=True,
+                     **opts)
+
+
+def test_actuation_rejects_without_opt_in():
+    async def t():
+        ctx = Ctx()
+        pool, _ = make_pool(ctx, targetClaimDelay=400.0)
+        await settle()
+        before = snap(pool)
+        assert pool.apply_control_decision(1, codel_target=100.0) is False
+        assert snap(pool) == before
+        pool.stop()
+    run_async(t())
+
+
+def test_actuation_rejects_bad_epochs_atomically():
+    async def t():
+        ctx = Ctx()
+        pool, _ = actuation_pool(ctx)
+        await settle()
+        assert pool.apply_control_decision(5, codel_target=200.0,
+                                           at_ms=1000.0) is True
+        before = snap(pool)
+        # Stale, equal, bool and non-int epochs all bounce untouched.
+        for epoch in (5, 4, 0, -1, True, 1.5, '6', None):
+            assert pool.apply_control_decision(
+                epoch, codel_target=100.0, at_ms=1500.0) is False, epoch
+            assert snap(pool) == before, epoch
+        # ...until the TTL passes: a restarted sampler's low epoch is
+        # trusted again.
+        late = 1000.0 + mod_pool.CONTROL_EPOCH_TTL + 1.0
+        assert pool.apply_control_decision(
+            1, codel_target=100.0, at_ms=late) is True
+        assert pool.p_ctrl_epoch == 1
+        assert pool.p_codel.cd_targdelay == 100.0
+        pool.stop()
+    run_async(t())
+
+
+def test_actuation_rejects_out_of_range_targets_atomically():
+    async def t():
+        ctx = Ctx()
+        pool, _ = actuation_pool(ctx)
+        await settle()
+        before = snap(pool)
+        bad = (mod_codel.CODEL_TARGET_MIN - 0.5,
+               mod_codel.CODEL_TARGET_MAX + 1.0,
+               0.0, -10.0, float('nan'), float('inf'), True, '100')
+        for i, target in enumerate(bad):
+            assert pool.apply_control_decision(
+                i + 1, codel_target=target) is False, target
+            assert snap(pool) == before, target
+        pool.stop()
+    run_async(t())
+
+
+def test_actuation_rejects_target_without_codel():
+    async def t():
+        ctx = Ctx()
+        pool, _ = make_pool(ctx, spares=2, maximum=8,
+                            controlActuation=True)
+        await settle()
+        before = snap(pool)
+        assert pool.apply_control_decision(1, codel_target=100.0) is False
+        assert snap(pool) == before
+        # spares-only decisions still work on a CoDel-less pool.
+        assert pool.apply_control_decision(1, spares=3) is True
+        assert pool.p_spares == 3
+        pool.stop()
+    run_async(t())
+
+
+def test_actuation_rejects_bad_spares_atomically():
+    async def t():
+        ctx = Ctx()
+        pool, _ = actuation_pool(ctx)
+        await settle()
+        before = snap(pool)
+        for i, spares in enumerate((-1, 9, 2.5, True, '3')):
+            # A valid target rides along: rejection must not half-apply.
+            assert pool.apply_control_decision(
+                i + 1, codel_target=150.0, spares=spares) is False, spares
+            assert snap(pool) == before, spares
+        pool.stop()
+    run_async(t())
+
+
+def test_actuation_accepts_and_bumps_epoch():
+    async def t():
+        ctx = Ctx()
+        pool, _ = actuation_pool(ctx)
+        await settle()
+        state_before = pool.get_state()
+        assert pool.apply_control_decision(
+            3, codel_target=125.0, spares=4) is True
+        assert pool.p_ctrl_epoch == 3
+        assert pool.p_codel.cd_targdelay == 125.0
+        assert pool.p_spares == 4
+        assert pool.get_state() == state_before
+        pool.stop()
+    run_async(t())
+
+
+# -- partition rules --------------------------------------------------------
+
+def test_match_partition_rules_first_match_and_rank0():
+    tree = {'targets': jnp.zeros((4,)), 'epoch': jnp.int32(0)}
+    rules = [('targets', P('x')), ('.*', P('y'))]
+    specs = ctl.match_partition_rules(rules, tree)
+    assert specs['targets'] == P('x')
+    # rank-0 leaves replicate regardless of any matching rule.
+    assert specs['epoch'] == P()
+
+
+def test_match_partition_rules_unmatched_leaf_raises():
+    tree = {'surprise_column': jnp.zeros((4,))}
+    with pytest.raises(ValueError, match='surprise_column'):
+        ctl.match_partition_rules([('targets', P('x'))], tree)
+
+
+def test_partition_rules_place_every_control_leaf():
+    state_specs, inp_specs, out_specs = ctl.control_specs(('pools',))
+    col = P(('pools',))
+    assert state_specs.targets == col
+    assert state_specs.epoch == P()
+    assert state_specs.now_ms == P()
+    assert inp_specs.sojourns == col
+    assert inp_specs.now_ms == P()
+    _, dec_specs, fleet_specs = out_specs
+    assert dec_specs['codel_target'] == col
+    assert dec_specs['epoch'] == P()
+    for name in ('n_pools', 'pressure', 'mean_load', 'max_sojourn'):
+        assert fleet_specs[name] == P(), name
+
+
+# -- batched actuation + shard reduce ---------------------------------------
+
+class FakePool:
+    def __init__(self, accept=True):
+        self.accept = accept
+        self.calls = []
+
+    def apply_control_decision(self, epoch, codel_target=None,
+                               spares=None, at_ms=None):
+        self.calls.append((epoch, codel_target, spares, at_ms))
+        return self.accept
+
+
+def test_apply_decisions_counts_and_zero_target():
+    decisions = {
+        'codel_target': np.asarray([150.0, 0.0, 200.0]),
+        'plan_spares': np.asarray([2, 3, 4], np.int32),
+        'epoch': np.int32(7),
+    }
+    ok, nope = FakePool(True), FakePool(False)
+    res = ctl.apply_decisions(
+        {0: ok, 1: nope, 2: object()}, decisions, at_ms=50.0)
+    assert res == {'applied': 1, 'rejected': 1, 'skipped': 1,
+                   'epoch': 7}
+    # 0.0 in the column means "no CoDel decision", passed as None.
+    assert ok.calls == [(7, 150.0, 2, 50.0)]
+    assert nope.calls == [(7, None, 3, 50.0)]
+
+
+def test_reduce_control_weights_by_pool_count():
+    a = {'fleet': {'n_pools': 3.0, 'pressure': 1.0, 'mean_load': 2.0,
+                   'max_sojourn': 10.0}, 'applied': 2, 'rejected': 1}
+    b = {'fleet': {'n_pools': 1.0, 'pressure': 0.0, 'mean_load': 6.0,
+                   'max_sojourn': 40.0}, 'applied': 1, 'skipped': 3}
+    out = ctl.reduce_control([a, None, b])
+    assert out['n_pools'] == 4.0
+    assert out['pressure'] == pytest.approx(0.75)
+    assert out['mean_load'] == pytest.approx(3.0)
+    assert out['max_sojourn'] == 40.0
+    assert (out['applied'], out['rejected'], out['skipped']) == (3, 1, 3)
+    empty = ctl.reduce_control([])
+    assert empty['n_pools'] == 0.0 and empty['applied'] == 0
+
+
+# -- the 100k meshed-vs-plain soak ------------------------------------------
+
+SOAK_ROWS = 100_000
+SOAK_STEPS = 4
+
+
+def pools_mesh(n=8):
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    assert len(devs) >= n, 'conftest should have forced 8 CPU devices'
+    return Mesh(np.array(devs[:n]), ('pools',))
+
+
+def soak_inputs(rng, n, step):
+    """One tick's worth of adversarial columns: a third of the fleet
+    CoDel-less, sojourns straddling the targets, occasional resets."""
+    target = np.where(rng.random(n) < 0.33, np.inf,
+                      rng.integers(50, 800, n).astype(np.float64))
+    return ctl.control_inputs(
+        n,
+        samples=jnp.asarray(rng.random(n) * 12.0, jnp.float32),
+        sojourns=jnp.asarray(rng.random(n) * 900.0, jnp.float32),
+        filtered=jnp.asarray(rng.random(n) * 10.0, jnp.float32),
+        target_delay=jnp.asarray(target, jnp.float32),
+        spares=jnp.asarray(rng.integers(0, 6, n), jnp.float32),
+        maximum=jnp.asarray(rng.integers(6, 20, n), jnp.float32),
+        active=jnp.asarray(rng.random(n) < 0.9),
+        reset=jnp.asarray(rng.random(n) < 0.02),
+        now_ms=jnp.float32(1000.0 * (step + 1)))
+
+
+def host(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def test_meshed_and_shardmap_match_plain_bit_for_bit_100k():
+    mesh = pools_mesh()
+    meshed = ctl.make_control_step(mesh)
+    mapped = ctl.make_shardmap_control_step(mesh)
+
+    plain_state = ctl.control_init(SOAK_ROWS)
+    mesh_state = ctl.shard_control_state(
+        ctl.control_init(SOAK_ROWS), mesh)
+    map_state = ctl.control_init(SOAK_ROWS)
+
+    rng = np.random.default_rng(1729)
+    for step in range(SOAK_STEPS):
+        inp = soak_inputs(rng, SOAK_ROWS, step)
+
+        plain_state, p_dec, p_fleet = ctl.control_step(plain_state, inp)
+        # make_control_step donates: hand it its own state lineage.
+        mesh_state, m_dec, m_fleet = meshed(
+            mesh_state, ctl.shard_control_inputs(inp, mesh))
+        map_state, s_dec, s_fleet = mapped(map_state, inp)
+
+        p_dec, m_dec, s_dec = host(p_dec), host(m_dec), host(s_dec)
+        for key in p_dec:
+            np.testing.assert_array_equal(
+                p_dec[key], m_dec[key], err_msg='meshed %s' % key)
+            np.testing.assert_array_equal(
+                p_dec[key], s_dec[key], err_msg='shardmap %s' % key)
+        for st in (mesh_state, map_state):
+            np.testing.assert_array_equal(
+                np.asarray(plain_state.targets), np.asarray(st.targets))
+        # Decision-feeding aggregates are int/max reductions, so even
+        # across shards they are bit-exact; mean_load (float gauge) is
+        # merely close.
+        for fl in (host(m_fleet), host(s_fleet)):
+            assert fl['n_pools'] == host(p_fleet)['n_pools']
+            assert fl['pressure'] == host(p_fleet)['pressure']
+            assert fl['max_sojourn'] == host(p_fleet)['max_sojourn']
+            np.testing.assert_allclose(
+                fl['mean_load'], host(p_fleet)['mean_load'], rtol=1e-5)
+
+    # The soak actually exercised the AIMD law: targets moved off the
+    # configured base in both directions.
+    targets = np.asarray(plain_state.targets)
+    assert (targets > 0).sum() > SOAK_ROWS // 3
+    assert int(np.asarray(plain_state.epoch)) == SOAK_STEPS

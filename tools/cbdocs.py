@@ -278,6 +278,7 @@ def build_html(outdir: str, paths: list[str]) -> int:
 #: with __all__ use it; the integrations (no __all__) contribute every
 #: public top-level name they define themselves.
 API_MODULES = ('cueball_tpu', 'cueball_tpu.parallel',
+               'cueball_tpu.parallel.control',
                'cueball_tpu.ops', 'cueball_tpu.netsim',
                'cueball_tpu.shard',
                'cueball_tpu.integrations.httpx',
