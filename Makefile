@@ -5,8 +5,8 @@ LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
 .PHONY: test check bench bench-host bench-sharded bench-control \
-	dryrun coverage native ci docs docs-check fsm-graph scenarios \
-	scenarios-fast
+	bench-health dryrun coverage native ci docs docs-check fsm-graph \
+	scenarios scenarios-fast
 
 native:
 	$(PYTHON) native/build.py
@@ -67,6 +67,14 @@ bench-host:
 # step, and the controlActuation claim-path A/B. One JSON line.
 bench-control:
 	$(PYTHON) bench.py --control-only
+
+# Fleet-health stages alone (docs/observability.md §Fleet health
+# analytics): the fused anomaly/SLO health step swept at 10k/100k
+# backends, and the per-backend-attribution claim-path A/B (three
+# interleaved arms, tracing on everywhere, sink attached in the on
+# arm). One JSON line.
+bench-health:
+	$(PYTHON) bench.py --health-only
 
 # The shard-router scaling sweep only (docs/sharding.md): K=1,2,4,8
 # spawn-backend shards, aggregate claim throughput per K, and the

@@ -827,6 +827,100 @@ async def bench_actuation_ab(ops=TRACING_AB_OPS_PER_TRIAL,
     return out
 
 
+async def bench_attribution_ab(ops=TRACING_AB_OPS_PER_TRIAL,
+                               trials=TRACING_AB_TRIALS):
+    """Attribution-off vs -on claim-path A/B (ISSUE 10 acceptance:
+    per-backend attribution must cost <= 1% on the claim hot path).
+
+    Same interleaved three-arm protocol as the tracing A/B, but EVERY
+    arm runs with tracing enabled at full rate: the quantity under
+    test is the increment the attribution layer adds on top of the
+    already-budgeted tracing cost, not tracing itself. The 'on' arm
+    additionally has a BackendTable registered as a backend sink —
+    exactly what HealthMonitor.start() attaches — so each finished
+    claim folds into the per-backend latency/error columns inline."""
+    import gc
+    import statistics
+    from cueball_tpu import trace as mod_trace
+    from cueball_tpu.parallel.health import BackendTable
+    build_pool = make_fixture()
+    pool = build_pool()
+    await settle(pool)
+    mod_trace.enable_tracing(ring_size=256, sample_rate=1.0)
+
+    async def run_arm(attribution):
+        table = None
+        if attribution:
+            table = BackendTable()
+            mod_trace.add_backend_sink(table)
+        try:
+            gc.disable()
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                hdl, conn = await pool.claim({'timeout': 1000})
+                hdl.release()
+            elapsed = time.perf_counter() - t0
+            gc.enable()
+        finally:
+            if table is not None:
+                mod_trace.remove_backend_sink(table)
+        return ops / elapsed
+
+    arms = {'off_pre': [], 'on': [], 'off_post': []}
+    warmup = True
+    frozen = False
+    speed_redos = 0
+    try:
+        while len(arms['on']) < trials:
+            if not warmup and not frozen:
+                gc.collect()
+                gc.freeze()
+                frozen = True
+            gc.collect()
+            await speed_gate()
+            rates = {arm: await run_arm(arm == 'on') for arm in arms}
+            clean = _speed_ok(_speed_probe())
+            if warmup:
+                warmup = False
+                continue
+            if not clean and speed_redos < trials:
+                speed_redos += 1
+                continue
+            for arm, rate in rates.items():
+                arms[arm].append(rate)
+    finally:
+        mod_trace.disable_tracing()
+    pool.stop()
+    while not pool.is_in_state('stopped'):
+        await asyncio.sleep(0.01)
+
+    out = {}
+    for arm, xs in arms.items():
+        out[arm + '_ops_per_sec'] = round(statistics.mean(xs), 1)
+        out[arm + '_stdev'] = round(
+            statistics.stdev(xs) if len(xs) > 1 else 0.0, 1)
+        out[arm + '_trials'] = [round(r, 1) for r in xs]
+    per_round = []
+    for i in range(len(arms['on'])):
+        off_i = (arms['off_pre'][i] + arms['off_post'][i]) / 2.0
+        per_round.append(100.0 * (off_i - arms['on'][i]) / off_i)
+    out['attribution_on_overhead_pct_rounds'] = [
+        round(x, 2) for x in per_round]
+    out['attribution_on_overhead_pct'] = round(
+        statistics.median(per_round), 2)
+    out['speed_gate_redone_rounds'] = speed_redos
+    out['protocol'] = ('%d rounds x %d ops x 3 interleaved arms '
+                       '(off-pre / on / off-post) back to back against '
+                       'one settled pool, tracing enabled at full rate '
+                       'in ALL arms; on = a BackendTable attribution '
+                       'sink attached; 1 warmup round, gc '
+                       'frozen+disabled in timed sections, speed-gated '
+                       'with degraded rounds redone; overhead pct is '
+                       'the median of per-round paired deltas') % (
+        trials, ops)
+    return out
+
+
 async def bench_pump_ab(ops=CLAIM_OPS_PER_TRIAL, trials=CLAIM_TRIALS):
     """Pump-off vs pump-on claim-path A/B (the tentpole's receipt).
 
@@ -942,6 +1036,10 @@ TELEM_TICK_SIZES = (1024, 10240, 102400)
 # the control step (ISSUE 9): one arm must sit at or above 100k pools.
 CONTROL_SIZES = (10_240, 102_400, 1_048_576)
 
+# The health-step sweep (ISSUE 10): the fused anomaly/SLO verdict step
+# at 10k and 100k backends (the bit-exactness soak's shape).
+HEALTH_SIZES = (10_240, 102_400)
+
 # The code whose behavior the chip numbers measure: the kernels, the
 # batched laws + shardings, the entry shapes, AND the live sampler +
 # monitor (the tick_cost stages time FleetSampler.sample_once end to
@@ -949,6 +1047,7 @@ CONTROL_SIZES = (10_240, 102_400, 1_048_576)
 # change stales the artifact without hashing all of bench.py.
 _TELEM_CODE = ('cueball_tpu/ops', 'cueball_tpu/parallel/telemetry.py',
                'cueball_tpu/parallel/control.py',
+               'cueball_tpu/parallel/health.py',
                'cueball_tpu/parallel/sampler.py',
                'cueball_tpu/monitor.py', '__graft_entry__.py')
 
@@ -977,7 +1076,8 @@ def telemetry_code_hash() -> str:
         with open(p, 'rb') as f:
             h.update(f.read())
     h.update(repr((TELEM_POOLS, TELEM_SMALL,
-                   TELEM_TICK_SIZES, CONTROL_SIZES)).encode())
+                   TELEM_TICK_SIZES, CONTROL_SIZES,
+                   HEALTH_SIZES)).encode())
     return h.hexdigest()[:16]
 
 
@@ -1303,6 +1403,72 @@ def bench_fleet_sweeps_host(sizes=CONTROL_SIZES) -> dict:
     return out
 
 
+def _health_sweeps(sizes=HEALTH_SIZES) -> dict:
+    """The health-step sweep (ISSUE 10): the fused anomaly/SLO verdict
+    step at 10k/100k backends through its donated live form, on
+    whatever backend the calling process sees. Inputs are
+    deterministic but non-degenerate — latencies cycle 1..16 ms and
+    errors strike every 50th row — so the gray-scoring and burn-rate
+    branches both stay live."""
+    import jax
+    import jax.numpy as jnp
+    from cueball_tpu.parallel import health as hl
+
+    step = hl.make_health_step()
+    rate = {}
+    us = {}
+    for n in sizes:
+        iters = max(10, min(100, 4_000_000 // n))
+        idx = jnp.arange(n)
+        lat_ms = 1.0 + (idx % 16).astype(jnp.float32)
+        bucket = jnp.minimum(
+            (jnp.log2(1.0 + lat_ms) * hl.BUCKET_SCALE).astype(
+                jnp.int32), hl.LAT_BINS - 1)
+        one_hot = jax.nn.one_hot(bucket, hl.LAT_BINS, dtype=jnp.int32)
+        inp = hl.health_inputs(
+            n,
+            lat_sum=lat_ms * 10.0,
+            lat_count=jnp.full((n,), 10, jnp.int32),
+            lat_buckets=one_hot * 10,
+            claim_buckets=one_hot * 10,
+            errors=(idx % 50 == 0).astype(jnp.int32),
+            active=jnp.ones((n,), bool),
+            eligible=idx > 0,
+            now_ms=1000.0)
+        state = hl.health_init(n)
+        out = step(state, inp)           # compile + donate the init
+        jax.block_until_ready(out)
+        state = out[0]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, _verdicts, _fleet = step(state, inp)
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+        rate[str(n)] = round(n * iters / dt, 1)
+        us[str(n)] = round(1e6 * dt / iters, 1)
+    return {'health_step_pools_per_sec': rate, 'health_step_us': us}
+
+
+def bench_health_sweeps_host(sizes=HEALTH_SIZES) -> dict:
+    """The health-step sweep on the HOST CPU backend, so the round's
+    health columns are never silently null. Same CPU-pinning rules as
+    bench_sampler_tick_host — the container sitecustomize
+    force-registers the TPU backend and a wedged tunnel blocks backend
+    init indefinitely, so this must pin CPU itself."""
+    try:
+        import jax
+    except ImportError:
+        return {}
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except RuntimeError:
+        if jax.default_backend() != 'cpu':
+            return {}
+    out = _health_sweeps(sizes)
+    out['backend'] = jax.default_backend()
+    return out
+
+
 def _telemetry_child_main(progress_path: str) -> None:
     """Child-process entry: run the stages against the real backend,
     appending each stage to the progress file as it lands."""
@@ -1577,7 +1743,8 @@ def artifact_citation(root: str | None = None) -> dict:
 def assemble_result(abs_err, claim, queued, host_tick, telem,
                     tracing_ab=None, pump_ab=None,
                     probe=None, sharded=None, sweeps=None,
-                    actuation_ab=None) -> dict:
+                    actuation_ab=None, attribution_ab=None,
+                    health=None) -> dict:
     """Build the single JSON-line result from the stage outputs.
 
     Factored out of main() so the guard tests can assert the
@@ -1691,6 +1858,16 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
             telem.get('backend') or sweeps.get('backend'))
     if actuation_ab is not None:
         result['claim_actuation_ab'] = actuation_ab
+    if attribution_ab is not None:
+        result['claim_attribution_ab'] = attribution_ab
+    if health:
+        # The health-step sweep rides the same never-silently-null
+        # rule as the control columns: the host CPU copy always runs,
+        # labelled with the backend that produced it.
+        result['health_step_pools_per_sec'] = \
+            health.get('health_step_pools_per_sec')
+        result['health_step_us'] = health.get('health_step_us')
+        result['health_step_backend'] = health.get('backend')
     if tracing_ab is not None:
         result['claim_tracing_ab'] = tracing_ab
     if pump_ab is not None:
@@ -1724,7 +1901,7 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
 
 
 async def main(host_only: bool = False, sharded_only: bool = False,
-               control_only: bool = False):
+               control_only: bool = False, health_only: bool = False):
     """Run the bench and print ONE JSON line.
 
     host_only=True (the `make bench-host` / --host-only path) runs
@@ -1733,7 +1910,9 @@ async def main(host_only: bool = False, sharded_only: bool = False,
     the chip subprocess entirely: no accelerator touched, no 300 s
     telemetry timeout to wait out. control_only=True (`make
     bench-control`) runs just the control-plane stages: the 10k->1M
-    telemetry/control sweep plus the actuation-hooks claim A/B."""
+    telemetry/control sweep plus the actuation-hooks claim A/B.
+    health_only=True (`make bench-health`) runs just the fleet-health
+    stages: the health-step sweep plus the attribution claim A/B."""
     # Pin THIS process to CPU: the host benchmarks must not share the
     # GIL with the axon tunnel machinery (its retry threads measurably
     # depress claim throughput when the chip tunnel is unhealthy). The
@@ -1783,6 +1962,21 @@ async def main(host_only: bool = False, sharded_only: bool = False,
         }))
         return
 
+    if health_only:
+        # `make bench-health`: the fleet-health stages alone.
+        sweeps = bench_health_sweeps_host()
+        attribution_ab = await bench_attribution_ab()
+        print(json.dumps({
+            'health_only': True,
+            'health_step_pools_per_sec':
+                sweeps.get('health_step_pools_per_sec'),
+            'health_step_us': sweeps.get('health_step_us'),
+            'health_step_backend': sweeps.get('backend'),
+            'claim_attribution_ab': attribution_ab,
+            'telemetry_code_hash': telemetry_code_hash(),
+        }))
+        return
+
     # Probe the chip FIRST and carry the outcome into the round
     # record: --host-only rounds used to emit every chip field as a
     # bare null with nothing saying whether a capture was even
@@ -1797,6 +1991,7 @@ async def main(host_only: bool = False, sharded_only: bool = False,
     tracing_ab = await bench_tracing_ab()
     pump_ab = await bench_pump_ab()
     actuation_ab = await bench_actuation_ab()
+    attribution_ab = await bench_attribution_ab()
     host_tick = bench_sampler_tick_host()
     telem = {} if host_only else bench_telemetry_step_guarded(
         probe=probe)
@@ -1807,11 +2002,14 @@ async def main(host_only: bool = False, sharded_only: bool = False,
     if telem.get('control_step_pools_per_sec') is None \
             or telem.get('telemetry_pools_per_sec_sweep') is None:
         sweeps = bench_fleet_sweeps_host()
+    health = bench_health_sweeps_host()
 
     result = assemble_result(abs_err, claim, queued, host_tick, telem,
                              tracing_ab=tracing_ab, pump_ab=pump_ab,
                              probe=probe, sharded=sharded,
-                             sweeps=sweeps, actuation_ab=actuation_ab)
+                             sweeps=sweeps, actuation_ab=actuation_ab,
+                             attribution_ab=attribution_ab,
+                             health=health)
     if host_only:
         result['host_only'] = True
     print(json.dumps(result))
@@ -1821,4 +2019,5 @@ if __name__ == '__main__':
     import sys
     asyncio.run(main(host_only='--host-only' in sys.argv[1:],
                      sharded_only='--sharded-only' in sys.argv[1:],
-                     control_only='--control-only' in sys.argv[1:]))
+                     control_only='--control-only' in sys.argv[1:],
+                     health_only='--health-only' in sys.argv[1:]))

@@ -147,6 +147,12 @@ class SocketMgrFSM(FSM):
             raise AssertionError('options.constructor must be callable')
         self.sm_pool = options['pool']
         self.sm_backend = options['backend']
+        # Small-int backend identity for the attribution surfaces: the
+        # native trace recorder stamps it into slot flags so drained
+        # claims land in the right per-backend health column even when
+        # the Python-side span payload is gone (trace.backend_index).
+        self.sm_backend_index = mod_trace.backend_index(
+            self.sm_backend.get('key'))
         self.sm_constructor = constructor
         self.sm_slot = options['slot']
 

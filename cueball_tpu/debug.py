@@ -64,6 +64,40 @@ def _fsm_line(tag: str, fsm) -> str:
                                                  '->'.join(hist))
 
 
+def _health_section() -> str:
+    """'-- fleet health --' dump lines for every active HealthMonitor;
+    '' when the health engine was never imported or has no monitors."""
+    import sys
+    mod = sys.modules.get('cueball_tpu.parallel.health')
+    if mod is None:
+        return ''
+    monitors = mod.active_monitors()
+    if not monitors:
+        return ''
+    out = ['-- fleet health (%d monitor(s)) --' % len(monitors)]
+    for mon in monitors:
+        last = mon.hm_last
+        if last is None:
+            out.append('  (no tick yet)')
+            continue
+        f = last['fleet']
+        out.append(
+            '  epoch=%d backends=%d gray=%s burn_fast=%.2f '
+            'burn_slow=%.2f p99=%.1fms err_rate=%.4f%s%s' % (
+                last['epoch'], int(f['n_backends']),
+                ','.join(last['gray']) or '-',
+                float(f['burn_fast']), float(f['burn_slow']),
+                float(f['claim_p99_ms']), float(f['err_rate']),
+                ' PAGE' if f['alert_page'] else '',
+                ' TICKET' if f['alert_ticket'] else ''))
+        for key, b in sorted(last['backends'].items()):
+            if not b['gray']:
+                continue
+            out.append('   gray %-24s ewma=%.1fms z=%.1f score=%d' % (
+                key, b['ewma_ms'], b['z'], b['score']))
+    return '\n'.join(out) + '\n'
+
+
 def dump_fsm_histories(stream=None) -> str:
     """Dump state + history of every FSM registered with the pool
     monitor (pools, sets, DNS resolvers, and their connection slots and
@@ -120,6 +154,12 @@ def dump_fsm_histories(stream=None) -> str:
             buf.write(_fsm_line('shard %d' % sid, fsm))
         for name, rec in sorted(router.fr_pools.items()):
             buf.write('  pool %-24s -> shard %d\n' % (name, rec.shard_id))
+
+    # Active health monitors: the verdicts next to the FSM states, so
+    # one SIGUSR2 also answers "which backend is gray" and "is the SLO
+    # burning". Late-bound like the router section — the parallel
+    # package (and jax) is only consulted if something imported it.
+    buf.write(_health_section())
 
     # When claim tracing is on, the slowest recent claims land next to
     # the FSM states: a wedged process's dump answers both "what state

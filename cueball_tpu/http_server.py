@@ -17,7 +17,12 @@ from PoolMonitor.to_kang_options().
     GET /kang/obj/<type>/<id>   - one object's snapshot
     GET /kang/fleet             - attached FleetSampler's batched decisions
     GET /kang/shards            - started FleetRouters' shard snapshots
-    GET /kang/traces            - claim/DNS trace ring as NDJSON spans
+    GET /kang/traces            - claim/DNS trace ring as NDJSON spans;
+                                  ?limit=N keeps the newest N traces,
+                                  ?backend=<key> keeps only traces with
+                                  a span attributed to that backend
+    GET /kang/health            - health monitors' verdicts: per-backend
+                                  gray flags and SLO burn rates
     GET /metrics                - prometheus text metrics (collector)
 """
 
@@ -26,6 +31,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import urllib.parse
 
 from . import trace as mod_trace
 from .monitor import pool_monitor
@@ -122,13 +128,25 @@ async def _read_request(reader):
                 await reader.readexactly(n)
             except asyncio.IncompleteReadError:
                 return None
-    return method, path.partition('?')[0], keep_alive
+    return method, path, keep_alive
+
+
+def _health_payload() -> dict:
+    """Active HealthMonitors' verdicts, without importing the parallel
+    package (and jax) until something could actually have started one
+    (the same late-binding trick as trace._active_fleet_routers)."""
+    import sys
+    mod = sys.modules.get('cueball_tpu.parallel.health')
+    if mod is None:
+        return {'n_monitors': 0, 'monitors': [], 'fleet': {}}
+    return mod.health_snapshot()
 
 
 def _route(method: str, path: str, collector):
     """Dispatch one request; returns (status, ctype, body)."""
     if method != 'GET':
         return 405, 'application/json', b'{"error": "GET only"}'
+    path, _, query = path.partition('?')
     ctype = 'application/json'
     try:
         if path == '/kang/snapshot':
@@ -154,8 +172,21 @@ def _route(method: str, path: str, collector):
         elif path == '/kang/traces':
             # Completed claim/DNS traces, one OTLP-field-named span per
             # line (see trace.py). Empty body when tracing is off.
-            body = mod_trace.export_ndjson().encode()
+            # ?limit=N / ?backend=<key> narrow to whole traces (the
+            # slow claims attributed to a flagged backend).
+            params = urllib.parse.parse_qs(query,
+                                           keep_blank_values=True)
+            limit = backend = None
+            if 'limit' in params:
+                limit = int(params['limit'][-1])
+            if 'backend' in params:
+                backend = params['backend'][-1]
+            body = mod_trace.filter_ndjson(
+                mod_trace.export_ndjson(), limit, backend).encode()
             ctype = 'application/x-ndjson'
+        elif path == '/kang/health':
+            body = json.dumps(_health_payload(),
+                              default=_json_default).encode()
         elif path == '/metrics' and collector is not None:
             body = collector.collect().encode()
             ctype = 'text/plain; version=0.0.4'

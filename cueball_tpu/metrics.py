@@ -30,6 +30,28 @@ def _escape_label_value(value) -> str:
             .replace('\n', '\\n'))
 
 
+def _unescape_label_value(value: str) -> str:
+    """Invert :func:`_escape_label_value` (a left-to-right scan — naive
+    chained .replace() corrupts a trailing backslash followed by 'n')."""
+    out = []
+    i, n = 0, len(value)
+    while i < n:
+        c = value[i]
+        if c == '\\' and i + 1 < n:
+            nxt = value[i + 1]
+            if nxt == 'n':
+                out.append('\n')
+                i += 2
+                continue
+            if nxt in ('\\', '"'):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return ''.join(out)
+
+
 def _format_sample(name: str, key: tuple, value: float) -> str:
     """One exposition line. Empty label sets render with no braces at
     all ('name value', not 'name{} value')."""
@@ -269,6 +291,11 @@ def merge_expositions(texts) -> str:
                     fam['type'] = rest
                 current = name
                 continue
+            if line.lstrip().startswith('#'):
+                # Any other comment (including a bare '# HELP'): not a
+                # family header, not a sample — never let it masquerade
+                # as a metric family named '#'.
+                continue
             # A sample line; histogram rows (name_bucket/_sum/_count)
             # belong to the family whose headers precede them.
             if current is None:
@@ -284,7 +311,9 @@ def merge_expositions(texts) -> str:
     for name in order:
         fam = families[name]
         if fam['help'] is not None:
-            out.append('# HELP %s %s' % (name, fam['help']))
+            # rstrip keeps an empty help string from leaving a
+            # trailing space on the header line.
+            out.append(('# HELP %s %s' % (name, fam['help'])).rstrip())
         if fam['type'] is not None:
             out.append('# TYPE %s %s' % (name, fam['type']))
         out.extend(fam['samples'])

@@ -128,6 +128,24 @@ class Scenario:
                     for t in done[:3]]
             except Exception:
                 pass  # the dump must never mask the original error
+        health = sys.modules.get('cueball_tpu.parallel.health')
+        if health is not None:
+            # The health engine ran during this scenario: embed every
+            # active monitor's verdict history, so the dump answers
+            # "which backend was judged gray, and when" next to the
+            # slow traces. Late-bound like the other jax surfaces —
+            # a scenario that never imported it pays nothing.
+            try:
+                monitors = health.active_monitors()
+                if monitors:
+                    record['health'] = {
+                        'fleet': health.reduce_health(
+                            [m.hm_last for m in monitors]),
+                        'history': [list(m.hm_history)
+                                    for m in monitors],
+                    }
+            except Exception:
+                pass  # same rule: never mask the original error
         try:
             os.makedirs(dump_dir, exist_ok=True)
             with open(path, 'w') as f:

@@ -11,24 +11,38 @@ aggregates (mean load, overload fraction, retry pressure) becoming XLA
 all-reduces over ICI. parallel.sampler bridges the live runtime into
 that step: it samples every pool registered in the process-global
 monitor each LP tick and publishes the batched decisions.
+parallel.health judges the sampled fleet: per-backend claim
+attribution folds into robust on-mesh anomaly detection (gray
+flags with hysteresis) and SLO burn-rate tracking.
 """
 
 from .control import (ControlInputs, ControlState, apply_decisions,
                       control_init, control_inputs, control_step,
                       make_control_step, make_shardmap_control_step,
                       reduce_control)
+from .health import (DEFAULT_OBJECTIVES, BackendTable, HealthInputs,
+                     HealthMonitor, HealthState, SLOObjectives,
+                     health_init, health_inputs, health_snapshot,
+                     health_step, make_health_step,
+                     make_shardmap_health_step, reduce_health)
 from .sampler import FleetSampler
 from .telemetry import (FleetInputs, FleetState, fleet_init,
                         fleet_inputs, fleet_scan, fleet_step,
-                        make_live_step, make_sharded_scan,
-                        make_sharded_step, make_shardmap_step,
-                        shard_inputs, shard_state, shard_window)
+                        fold_backend_slots, make_live_step,
+                        make_sharded_scan, make_sharded_step,
+                        make_shardmap_step, shard_inputs, shard_state,
+                        shard_window)
 
-__all__ = ['ControlInputs', 'ControlState', 'FleetInputs',
-           'FleetSampler', 'FleetState', 'apply_decisions',
+__all__ = ['BackendTable', 'ControlInputs', 'ControlState',
+           'DEFAULT_OBJECTIVES', 'FleetInputs', 'FleetSampler',
+           'FleetState', 'HealthInputs', 'HealthMonitor',
+           'HealthState', 'SLOObjectives', 'apply_decisions',
            'control_init', 'control_inputs', 'control_step',
            'fleet_init', 'fleet_inputs', 'fleet_scan', 'fleet_step',
-           'make_control_step', 'make_live_step', 'make_sharded_scan',
+           'fold_backend_slots', 'health_init', 'health_inputs',
+           'health_snapshot', 'health_step', 'make_control_step',
+           'make_health_step', 'make_live_step', 'make_sharded_scan',
            'make_sharded_step', 'make_shardmap_control_step',
-           'make_shardmap_step', 'reduce_control', 'shard_inputs',
+           'make_shardmap_health_step', 'make_shardmap_step',
+           'reduce_control', 'reduce_health', 'shard_inputs',
            'shard_state', 'shard_window']

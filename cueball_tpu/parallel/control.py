@@ -346,7 +346,8 @@ def shard_control_inputs(inp: ControlInputs, mesh: Mesh,
 
 # -- batched host actuation -------------------------------------------------
 
-def apply_decisions(pools_by_row, decisions, at_ms=None) -> dict:
+def apply_decisions(pools_by_row, decisions, at_ms=None,
+                    health=None) -> dict:
     """Apply one step's decision columns to live pools.
 
     ``pools_by_row`` maps row index -> pool (the sampler's
@@ -355,12 +356,16 @@ def apply_decisions(pools_by_row, decisions, at_ms=None) -> dict:
     ``apply_control_decision`` — the guarded API that validates the
     epoch and every field BEFORE mutating anything — and flags its own
     telemetry row dirty on accept, so the next tick re-gathers exactly
-    the rows that moved. Pools without the API are skipped. Returns
+    the rows that moved. Pools without the API are skipped. ``health``
+    (an optional fleet health citation, see parallel.health) is
+    forwarded alongside accepted decisions for the pool's audit
+    trail. Returns
     ``{'applied': n, 'rejected': n, 'skipped': n, 'epoch': e}``."""
     import numpy as np
     ct = np.asarray(decisions['codel_target'])
     sp = np.asarray(decisions['plan_spares'])
     epoch = int(decisions['epoch'])
+    extra = {} if health is None else {'health': health}
     applied = rejected = skipped = 0
     for row, pool in pools_by_row.items():
         apply = getattr(pool, 'apply_control_decision', None)
@@ -370,7 +375,7 @@ def apply_decisions(pools_by_row, decisions, at_ms=None) -> dict:
         target = float(ct[row])
         ok = apply(epoch,
                    codel_target=target if target > 0.0 else None,
-                   spares=int(sp[row]), at_ms=at_ms)
+                   spares=int(sp[row]), at_ms=at_ms, **extra)
         if ok:
             applied += 1
         else:

@@ -1,0 +1,825 @@
+"""Fleet health analytics: the step that JUDGES.
+
+parallel.telemetry batches the control laws and parallel.control closes
+the actuation loop, but neither names a culprit: an operator staring at
+``/metrics`` still cannot answer "which backend is gray?" or "is my SLO
+burning?". This module turns the raw per-backend attribution columns —
+folded out of drained claim spans by :class:`BackendTable` — into
+*judgments*, as one jitted pass over a backends axis sharded exactly
+like the control step:
+
+- **per-backend robust stats**: an EWMA of mean claim service latency
+  and a decayed log-bucket latency sketch per backend row, updated
+  elementwise so every mesh form computes identical values;
+- **anomaly detection**: each backend's EWMA is quantized onto an
+  integer log-latency score (16 units per doubling); the fleet baseline
+  is the MEDIAN score and its MAD, both computed from int32 score
+  histograms reduced across shards — order-independent sums, so the
+  z-score verdicts are bit-exact plain vs GSPMD vs shard_map (the same
+  discipline as parallel.control). A backend is flagged gray when its
+  score sits ``Z_THRESHOLD`` robust deviations AND at least one full
+  latency doubling above the median, with streak hysteresis
+  (``ENTER_STREAK`` ticks to flag, ``EXIT_STREAK`` clean ticks to
+  clear) so a single slow tick never pages anyone;
+- **SLO burn rates**: declared objectives (:class:`SLOObjectives`:
+  claim success rate and claim p99 latency) are evaluated per tick
+  from int32 fleet sums into instantaneous burn rates, smoothed into
+  fast- and slow-window EWMAs with the classic multiwindow alert
+  thresholds (fast > 14.4x budget pages, slow > 6x opens a ticket).
+
+Row 0 of the backends axis is RESERVED for unattributed traffic
+(claims that never reached a backend: timeouts, sheds before claim):
+it feeds the SLO sums but is masked out of gray detection via the
+``eligible`` input column, so an overloaded claim queue cannot frame
+an innocent backend.
+
+Host glue lives here too: :class:`BackendTable` accumulates the
+per-backend columns from the trace layer's backend sinks (rows keyed
+by ``trace.backend_index`` so the native flag stamp and the Python
+recorder agree), :class:`HealthMonitor` drives the step and publishes
+``cueball_backend_health{backend=...}`` / ``cueball_slo_burn_rate``
+gauges plus the ``/kang/health`` snapshot, and :func:`reduce_health`
+merges per-shard verdicts for the FleetRouter.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import math
+import threading
+import typing
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .control import match_partition_rules
+
+__all__ = ['BackendTable', 'DEFAULT_OBJECTIVES', 'HealthInputs',
+           'HealthMonitor', 'HealthState', 'SLOObjectives',
+           'active_monitors', 'health_init', 'health_inputs',
+           'health_partition_rules', 'health_snapshot', 'health_specs',
+           'health_step', 'latency_bucket', 'make_health_step',
+           'make_shardmap_health_step', 'reduce_health',
+           'shard_health_inputs', 'shard_health_state']
+
+#: Latency sketch geometry: bucket k spans latencies whose
+#: log2(1 + ms) falls in [k/4, (k+1)/4) — quarter-doubling buckets, so
+#: 64 buckets reach past 65 s. Integer counts per bucket are the ONLY
+#: thing reduced across shards, which is what buys bit-exactness.
+LAT_BINS = 64
+BUCKET_SCALE = 4.0
+#: Score geometry: 16 units per latency doubling, 256 bins (~2^16 ms).
+SCORE_BINS = 256
+SCORE_SCALE = 16.0
+
+#: EWMA smoothing for per-backend mean latency and the sketch decay
+#: (per health tick, not per second: the monitor owns the cadence).
+EWMA_ALPHA = 0.3
+SKETCH_DECAY = 0.9
+
+#: Gray verdict: z-score over the fleet median/MAD baseline, AND an
+#: absolute floor of one full doubling over the median score so tight
+#: fleets (MAD ~ 0) cannot page on noise, AND a minimum population to
+#: baseline against.
+Z_THRESHOLD = 3.5
+GRAY_FLOOR_Q = int(SCORE_SCALE)
+MIN_BASELINE = 4
+ENTER_STREAK = 3
+EXIT_STREAK = 5
+
+#: Burn-rate smoothing and the multiwindow alert thresholds
+#: (fast window pages, slow window files a ticket).
+FAST_ALPHA = 0.5
+SLOW_ALPHA = 0.05
+FAST_BURN_ALERT = 14.4
+SLOW_BURN_ALERT = 6.0
+
+
+class SLOObjectives(typing.NamedTuple):
+    """Declared service-level objectives, baked statically into the
+    jitted step (hashable, so step builders memoize per objective)."""
+    success_target: float = 0.999   # claim success rate
+    claim_p99_ms: float = 250.0     # claim latency p99 bound (ms)
+
+
+DEFAULT_OBJECTIVES = SLOObjectives()
+
+
+class HealthState(typing.NamedTuple):
+    """Carried (donated) per-backend health state."""
+    ewma_ms: jax.Array      # [B] f32 EWMA of mean service latency
+    lat_hist: jax.Array     # [B, LAT_BINS] f32 decayed latency sketch
+    gray_streak: jax.Array  # [B] i32 consecutive flagged ticks
+    ok_streak: jax.Array    # [B] i32 consecutive clean ticks
+    gray: jax.Array         # [B] bool current verdict
+    burn_fast_err: jax.Array  # scalar f32 fast-window error burn
+    burn_slow_err: jax.Array  # scalar f32 slow-window error burn
+    burn_fast_lat: jax.Array  # scalar f32 fast-window latency burn
+    burn_slow_lat: jax.Array  # scalar f32 slow-window latency burn
+    epoch: jax.Array        # scalar i32 verdict epoch
+    now_ms: jax.Array       # scalar f32 clock of the last step
+
+
+class HealthInputs(typing.NamedTuple):
+    """One health tick's per-backend attribution columns (drained from
+    a :class:`BackendTable`; all [B] except the sketches and clock)."""
+    lat_sum: jax.Array        # f32 sum of ok service latencies (ms)
+    lat_count: jax.Array      # i32 ok claims with a service latency
+    lat_buckets: jax.Array    # [B, LAT_BINS] i32 service sketch adds
+    claim_buckets: jax.Array  # [B, LAT_BINS] i32 claim-latency adds
+    errors: jax.Array         # i32 failed claims attributed here
+    shed: jax.Array           # i32 CoDel sheds attributed here
+    active: jax.Array         # bool: row carries traffic (feeds SLO)
+    eligible: jax.Array       # bool: row may be judged gray
+    reset: jax.Array          # bool: row newly (re)assigned
+    now_ms: jax.Array         # scalar clock (ms)
+
+
+def health_init(n_backends: int, epoch: int = 0) -> HealthState:
+    # Each leaf gets its own buffer: the live step donates the whole
+    # state, and aliased leaves would be "donated twice".
+    def zi():
+        return jnp.zeros((n_backends,), jnp.int32)
+    return HealthState(
+        ewma_ms=jnp.zeros((n_backends,), jnp.float32),
+        lat_hist=jnp.zeros((n_backends, LAT_BINS), jnp.float32),
+        gray_streak=zi(), ok_streak=zi(),
+        gray=jnp.zeros((n_backends,), bool),
+        burn_fast_err=jnp.float32(0.0), burn_slow_err=jnp.float32(0.0),
+        burn_fast_lat=jnp.float32(0.0), burn_slow_lat=jnp.float32(0.0),
+        epoch=jnp.int32(epoch), now_ms=jnp.float32(0.0))
+
+
+def health_inputs(n_backends: int, **kw) -> HealthInputs:
+    """A HealthInputs of idle defaults; override fields by keyword."""
+    zb = jnp.zeros((n_backends,), bool)
+    vals = dict(
+        lat_sum=jnp.zeros((n_backends,), jnp.float32),
+        lat_count=jnp.zeros((n_backends,), jnp.int32),
+        lat_buckets=jnp.zeros((n_backends, LAT_BINS), jnp.int32),
+        claim_buckets=jnp.zeros((n_backends, LAT_BINS), jnp.int32),
+        errors=jnp.zeros((n_backends,), jnp.int32),
+        shed=jnp.zeros((n_backends,), jnp.int32),
+        active=zb, eligible=zb, reset=zb,
+        now_ms=jnp.float32(0.0))
+    vals.update(kw)
+    return HealthInputs(**{k: jnp.asarray(v) for k, v in vals.items()})
+
+
+def latency_bucket(ms: float) -> int:
+    """The sketch bucket for one latency (host-side mirror of the
+    on-device geometry; also resolves SLO thresholds at trace time)."""
+    if not ms > 0.0:
+        return 0
+    return min(int(math.log2(1.0 + ms) * BUCKET_SCALE), LAT_BINS - 1)
+
+
+# -- the law ----------------------------------------------------------------
+
+def _observe_local(state: HealthState, inp: HealthInputs):
+    """Per-backend pre-reduction work: EWMA + sketch update and the
+    integer log-latency score. Elementwise, so identical on a shard."""
+    mean = inp.lat_sum / jnp.maximum(
+        inp.lat_count.astype(jnp.float32), 1.0)
+    have = inp.active & (inp.lat_count > 0)
+    prev = jnp.where(inp.reset, 0.0, state.ewma_ms)
+    ewma = jnp.where(
+        have,
+        jnp.where(prev > 0.0, prev + EWMA_ALPHA * (mean - prev), mean),
+        prev)
+    hist = jnp.where(inp.reset[:, None], 0.0, state.lat_hist)
+    hist = hist * SKETCH_DECAY + inp.lat_buckets.astype(jnp.float32)
+    score = jnp.clip(
+        jnp.round(SCORE_SCALE * jnp.log2(1.0 + ewma)),
+        0, SCORE_BINS - 1).astype(jnp.int32)
+    considered = inp.eligible & ~inp.reset & (ewma > 0.0)
+    return ewma, hist, score, considered
+
+
+def _health_sums(inp: HealthInputs, score, considered) -> dict:
+    """Shard-local reduction terms. Everything a VERDICT depends on is
+    an int32 sum (score/deviation/latency histograms, counts), so the
+    cross-shard combine is bit-exact regardless of reduction order."""
+    con = considered.astype(jnp.int32)
+    act = inp.active
+    onehot = (score[:, None]
+              == jnp.arange(SCORE_BINS, dtype=jnp.int32)[None, :])
+    return {
+        'score_hist': jnp.sum(onehot.astype(jnp.int32) * con[:, None],
+                              axis=0),
+        'n': jnp.sum(con),
+        'claim_hist': jnp.sum(
+            inp.claim_buckets * act.astype(jnp.int32)[:, None], axis=0),
+        'ok': jnp.sum(jnp.where(act, inp.lat_count, 0)),
+        'errors': jnp.sum(jnp.where(act, inp.errors, 0)),
+        'shed': jnp.sum(jnp.where(act, inp.shed, 0)),
+    }
+
+
+def _hist_median(hist, n):
+    """Median of an integer histogram: the first bin whose cumulative
+    count reaches rank (n+1)//2. Pure int compares — bit-exact."""
+    c = jnp.cumsum(hist)
+    rank = jnp.maximum((n + jnp.int32(1)) // 2, 1)
+    return jnp.argmax(c >= rank).astype(jnp.int32)
+
+
+def _deviation_hist(score, considered, med):
+    """Second-pass histogram of |score - median| (for the MAD)."""
+    dev = jnp.clip(jnp.abs(score - med), 0, SCORE_BINS - 1)
+    onehot = (dev[:, None]
+              == jnp.arange(SCORE_BINS, dtype=jnp.int32)[None, :])
+    return jnp.sum(
+        onehot.astype(jnp.int32) * considered.astype(jnp.int32)[:, None],
+        axis=0)
+
+
+def _judge(state: HealthState, inp: HealthInputs, ewma, hist, score,
+           considered, sums: dict, med, mad,
+           objectives: SLOObjectives):
+    """Post-reduction verdicts. `sums`/`med`/`mad` are fleet totals
+    (already combined across shards in the sharded forms)."""
+    enough = sums['n'] >= MIN_BASELINE
+    z = (score - med).astype(jnp.float32) / jnp.maximum(
+        mad, 1).astype(jnp.float32)
+    raw = (considered & enough & (z > Z_THRESHOLD)
+           & (score >= med + GRAY_FLOOR_Q))
+
+    gray_streak = jnp.where(
+        raw, jnp.where(inp.reset, 0, state.gray_streak) + 1, 0)
+    ok_streak = jnp.where(
+        raw, 0, jnp.where(inp.reset, 0, state.ok_streak) + 1)
+    prev_gray = jnp.where(inp.reset, False, state.gray)
+    gray = jnp.where(gray_streak >= ENTER_STREAK, True,
+                     jnp.where(ok_streak >= EXIT_STREAK, False,
+                               prev_gray))
+    gray = gray & considered
+
+    # SLO burn. Error objective: failed / attempted claims against the
+    # success budget. Latency objective: the fraction of claims over
+    # the declared p99 bound against its 1% budget. Both rates come
+    # from replicated int sums, so every mesh form smooths identically.
+    ops = sums['ok'] + sums['errors']
+    opsf = jnp.maximum(ops, 1).astype(jnp.float32)
+    err_rate = sums['errors'].astype(jnp.float32) / opsf
+    c = jnp.cumsum(sums['claim_hist'])
+    tot = c[-1]
+    rank99 = jnp.maximum(tot - tot // 100, 1)
+    k99 = jnp.argmax(c >= rank99).astype(jnp.int32)
+    p99_ms = jnp.exp2((k99.astype(jnp.float32) + 1.0)
+                      / BUCKET_SCALE) - 1.0
+    kt = latency_bucket(objectives.claim_p99_ms)
+    over_frac = ((tot - c[kt]).astype(jnp.float32)
+                 / jnp.maximum(tot, 1).astype(jnp.float32))
+    err_budget = max(1.0 - objectives.success_target, 1e-9)
+    burn_err = jnp.where(ops > 0, err_rate / err_budget, 0.0)
+    burn_lat = jnp.where(tot > 0, over_frac / 0.01, 0.0)
+
+    f_err = state.burn_fast_err + FAST_ALPHA * (
+        burn_err - state.burn_fast_err)
+    s_err = state.burn_slow_err + SLOW_ALPHA * (
+        burn_err - state.burn_slow_err)
+    f_lat = state.burn_fast_lat + FAST_ALPHA * (
+        burn_lat - state.burn_fast_lat)
+    s_lat = state.burn_slow_lat + SLOW_ALPHA * (
+        burn_lat - state.burn_slow_lat)
+
+    epoch = state.epoch + jnp.int32(1)
+    new_state = HealthState(
+        ewma_ms=ewma, lat_hist=hist, gray_streak=gray_streak,
+        ok_streak=ok_streak, gray=gray,
+        burn_fast_err=f_err, burn_slow_err=s_err,
+        burn_fast_lat=f_lat, burn_slow_lat=s_lat,
+        epoch=epoch, now_ms=inp.now_ms)
+    verdicts = {
+        'gray': gray,
+        'z': z,
+        'score': score,
+        'ewma_ms': ewma,
+        'epoch': epoch,
+    }
+    fleet = {
+        'n_backends': sums['n'],
+        'median_score': med,
+        'mad_score': mad,
+        'claim_p99_ms': p99_ms,
+        'err_rate': err_rate,
+        'over_frac': over_frac,
+        'ops': ops,
+        'errors': sums['errors'],
+        'shed': sums['shed'],
+        'burn_fast': jnp.maximum(f_err, f_lat),
+        'burn_slow': jnp.maximum(s_err, s_lat),
+        'alert_page': (f_err > FAST_BURN_ALERT)
+        | (f_lat > FAST_BURN_ALERT),
+        'alert_ticket': (s_err > SLOW_BURN_ALERT)
+        | (s_lat > SLOW_BURN_ALERT),
+    }
+    return new_state, verdicts, fleet
+
+
+def _make_law(objectives: SLOObjectives):
+    """The fused single-program health step (plain / GSPMD form) with
+    the objectives baked in as compile-time constants."""
+    def step(state: HealthState, inp: HealthInputs):
+        ewma, hist, score, considered = _observe_local(state, inp)
+        sums = _health_sums(inp, score, considered)
+        med = _hist_median(sums['score_hist'], sums['n'])
+        dev = _deviation_hist(score, considered, med)
+        mad = _hist_median(dev, sums['n'])
+        new_state, verdicts, fleet = _judge(
+            state, inp, ewma, hist, score, considered, sums, med, mad,
+            objectives)
+        fleet['n_gray'] = jnp.sum(verdicts['gray'].astype(jnp.int32))
+        return new_state, verdicts, fleet
+    return step
+
+
+#: One fused health tick for the whole fleet (single-device or GSPMD)
+#: under DEFAULT_OBJECTIVES. Returns (new_state, verdicts, fleet).
+health_step = jax.jit(_make_law(DEFAULT_OBJECTIVES))
+
+
+# -- partition rules --------------------------------------------------------
+
+def health_partition_rules(axes: tuple = ('pools',)):
+    """The ONE enumeration of how health data shards: the rank-2
+    latency sketches shard rows over the mesh axes (buckets
+    replicated), every per-backend column shards over the axes, and
+    scalars (clock, epoch, baselines, burn rates) replicate."""
+    return (
+        (r'(^|/)(lat_hist|lat_buckets|claim_buckets)$', P(axes, None)),
+        (r'.*', P(axes)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def health_specs(axes: tuple = ('pools',)):
+    """(state, inputs, outputs) PartitionSpec trees, derived by running
+    the rule table over abstract templates of the step."""
+    rules = health_partition_rules(axes)
+    state_t = jax.eval_shape(lambda: health_init(8))
+    inp_t = jax.eval_shape(lambda: health_inputs(8))
+    out_t = jax.eval_shape(_make_law(DEFAULT_OBJECTIVES),
+                           state_t, inp_t)
+    return (match_partition_rules(rules, state_t),
+            match_partition_rules(rules, inp_t),
+            match_partition_rules(rules, out_t))
+
+
+def health_shardings(mesh: Mesh, axes: tuple = ('pools',)):
+    """health_specs bound to a mesh as NamedShardings."""
+    place = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    return tuple(jax.tree.map(place, t, is_leaf=lambda x:
+                              isinstance(x, P))
+                 for t in health_specs(axes))
+
+
+@functools.lru_cache(maxsize=None)
+def make_health_step(mesh: Mesh | None = None,
+                     axes: tuple = ('pools',),
+                     objectives: SLOObjectives = DEFAULT_OBJECTIVES):
+    """The live health step: jitted, carried state DONATED, and (with
+    a mesh) every per-backend column sharded per the regex rules so
+    the histogram sums compile to hierarchical all-reduces. Do not
+    reuse a HealthState after passing it here — donation invalidates
+    it. Memoized per (mesh, axes, objectives)."""
+    step = _make_law(objectives)
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+    state_sh, inp_sh, out_sh = health_shardings(mesh, axes)
+    return jax.jit(step, in_shardings=(state_sh, inp_sh),
+                   out_shardings=out_sh, donate_argnums=0)
+
+
+def make_shardmap_health_step(
+        mesh: Mesh, axes: tuple = ('pools',),
+        objectives: SLOObjectives = DEFAULT_OBJECTIVES):
+    """SPMD form with hand-written collectives: elementwise stats on
+    the local shard, then TWO all-reduce phases (score histogram for
+    the median, deviation histogram for the MAD) plus the verdict
+    count, each reduced innermost mesh axis first (chip/ICI) then
+    outermost (host/DCN). All int32 sums — bit-exact vs the plain
+    step (tests/test_health.py soaks this at 100k rows)."""
+    try:
+        from jax import shard_map              # jax >= 0.8
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    state_specs, inp_specs, out_specs = health_specs(axes)
+
+    def _reduce(v, op):
+        for ax in reversed(axes):
+            v = op(v, ax)
+        return v
+
+    def local(state, inp):
+        ewma, hist, score, considered = _observe_local(state, inp)
+        sums = {k: _reduce(v, jax.lax.psum)
+                for k, v in _health_sums(inp, score,
+                                         considered).items()}
+        med = _hist_median(sums['score_hist'], sums['n'])
+        dev = _reduce(_deviation_hist(score, considered, med),
+                      jax.lax.psum)
+        mad = _hist_median(dev, sums['n'])
+        new_state, verdicts, fleet = _judge(
+            state, inp, ewma, hist, score, considered, sums, med, mad,
+            objectives)
+        fleet['n_gray'] = _reduce(
+            jnp.sum(verdicts['gray'].astype(jnp.int32)), jax.lax.psum)
+        return new_state, verdicts, fleet
+
+    return jax.jit(shard_map(
+        local, mesh=mesh, in_specs=(state_specs, inp_specs),
+        out_specs=out_specs))
+
+
+def shard_health_state(state: HealthState, mesh: Mesh,
+                       axes: tuple = ('pools',)) -> HealthState:
+    state_sh, _, _ = health_shardings(mesh, axes)
+    return jax.tree.map(jax.device_put, state, state_sh)
+
+
+def shard_health_inputs(inp: HealthInputs, mesh: Mesh,
+                        axes: tuple = ('pools',)) -> HealthInputs:
+    _, inp_sh, _ = health_shardings(mesh, axes)
+    return jax.tree.map(jax.device_put, inp, inp_sh)
+
+
+# -- host attribution table -------------------------------------------------
+
+class BackendTable:
+    """Per-backend accumulation columns, fed by the trace layer.
+
+    Rows are keyed by ``trace.backend_index`` — the SAME registry the
+    native emitter stamps into slot flags — so a claim attributed by
+    the C ring and one attributed by the Python recorder land in the
+    same row. Row 0 is the reserved unattributed bucket (key ``''``).
+    Implements the backend-sink protocol (``observe`` /
+    ``observe_shed``, called from trace drains on arbitrary threads);
+    ``drain`` hands one tick's columns to the monitor and zeroes the
+    accumulators atomically."""
+
+    __slots__ = ('bt_lock', 'bt_lat_sum', 'bt_lat_count',
+                 'bt_lat_buckets', 'bt_claim_buckets', 'bt_errors',
+                 'bt_shed', 'bt_seen', 'bt_fresh')
+
+    def __init__(self, capacity: int = 8):
+        import numpy as np
+        self.bt_lock = threading.Lock()
+        n = max(int(capacity), 1)
+        self.bt_lat_sum = np.zeros(n, np.float64)
+        self.bt_lat_count = np.zeros(n, np.int64)
+        self.bt_lat_buckets = np.zeros((n, LAT_BINS), np.int64)
+        self.bt_claim_buckets = np.zeros((n, LAT_BINS), np.int64)
+        self.bt_errors = np.zeros(n, np.int64)
+        self.bt_shed = np.zeros(n, np.int64)
+        self.bt_seen = np.zeros(n, bool)
+        self.bt_fresh: set = set()
+
+    def _row(self, key) -> int:
+        from .. import trace as mod_trace
+        row = mod_trace.backend_index(key or '')
+        if row >= len(self.bt_lat_sum):
+            self._grow(row + 1)
+        if not self.bt_seen[row]:
+            self.bt_seen[row] = True
+            self.bt_fresh.add(row)
+        return row
+
+    def _grow(self, need: int):
+        import numpy as np
+        n = len(self.bt_lat_sum)
+        while n < need:
+            n *= 2
+        pad = n - len(self.bt_lat_sum)
+        self.bt_lat_sum = np.concatenate(
+            [self.bt_lat_sum, np.zeros(pad, np.float64)])
+        self.bt_lat_count = np.concatenate(
+            [self.bt_lat_count, np.zeros(pad, np.int64)])
+        self.bt_lat_buckets = np.concatenate(
+            [self.bt_lat_buckets, np.zeros((pad, LAT_BINS), np.int64)])
+        self.bt_claim_buckets = np.concatenate(
+            [self.bt_claim_buckets,
+             np.zeros((pad, LAT_BINS), np.int64)])
+        self.bt_errors = np.concatenate(
+            [self.bt_errors, np.zeros(pad, np.int64)])
+        self.bt_shed = np.concatenate(
+            [self.bt_shed, np.zeros(pad, np.int64)])
+        self.bt_seen = np.concatenate(
+            [self.bt_seen, np.zeros(pad, bool)])
+
+    # -- the backend-sink protocol (trace.add_backend_sink) ------------
+
+    def observe(self, key, service_ms, claim_ms, ok: bool):
+        """One finished claim: `service_ms` is the lease (in-service)
+        duration for successful claims, `claim_ms` the whole claim
+        span; either may be None when the span never got there."""
+        with self.bt_lock:
+            row = self._row(key)
+            if ok and service_ms is not None:
+                self.bt_lat_sum[row] += float(service_ms)
+                self.bt_lat_count[row] += 1
+                self.bt_lat_buckets[
+                    row, latency_bucket(float(service_ms))] += 1
+            elif not ok:
+                self.bt_errors[row] += 1
+            if claim_ms is not None:
+                self.bt_claim_buckets[
+                    row, latency_bucket(float(claim_ms))] += 1
+
+    def observe_shed(self, key):
+        with self.bt_lock:
+            self.bt_shed[self._row(key)] += 1
+
+    def drain(self) -> dict:
+        """Swap out one tick's columns (numpy, host-side) and zero the
+        accumulators. 'active'/'eligible'/'reset' are the step's row
+        masks; row count is whatever the table has grown to."""
+        import numpy as np
+        with self.bt_lock:
+            n = len(self.bt_lat_sum)
+            out = {
+                'lat_sum': self.bt_lat_sum.astype(np.float32),
+                'lat_count': self.bt_lat_count.astype(np.int32),
+                'lat_buckets': self.bt_lat_buckets.astype(np.int32),
+                'claim_buckets':
+                    self.bt_claim_buckets.astype(np.int32),
+                'errors': self.bt_errors.astype(np.int32),
+                'shed': self.bt_shed.astype(np.int32),
+                'active': self.bt_seen.copy(),
+            }
+            eligible = self.bt_seen.copy()
+            eligible[0] = False
+            out['eligible'] = eligible
+            reset = np.zeros(n, bool)
+            for row in self.bt_fresh:
+                reset[row] = True
+            out['reset'] = reset
+            self.bt_fresh = set()
+            self.bt_lat_sum[:] = 0.0
+            self.bt_lat_count[:] = 0
+            self.bt_lat_buckets[:] = 0
+            self.bt_claim_buckets[:] = 0
+            self.bt_errors[:] = 0
+            self.bt_shed[:] = 0
+        return out
+
+
+#: Gauge families the monitor publishes (docs/observability.md).
+_HEALTH_GAUGES = {
+    'cueball_backend_health':
+        'backend verdict: 0 healthy, 1 flagged gray',
+    'cueball_backend_latency_ewma_ms':
+        'EWMA of mean claim service latency per backend (ms)',
+    'cueball_slo_burn_rate':
+        'SLO burn rate (budget multiples) per objective and window',
+}
+
+_MONITORS: list = []
+_MONITORS_LOCK = threading.Lock()
+
+
+class HealthMonitor:
+    """Drives the health step over a BackendTable and fans verdicts
+    out to every surface: gauges, /kang/health, the SIGUSR2 dump and
+    (via :func:`reduce_health`) the FleetRouter.
+
+    Options: ``objectives`` (SLOObjectives), ``collector`` (metrics
+    Collector; falls back to the active trace collector), ``mesh`` +
+    ``meshAxes`` (shard the step), ``shard`` (gauge label),
+    ``history`` (verdict ring length), ``interval`` (advisory tick
+    period, ms — the owner calls :meth:`tick`)."""
+
+    def __init__(self, options: dict | None = None):
+        options = dict(options or {})
+        self.hm_objectives: SLOObjectives = (
+            options.get('objectives') or DEFAULT_OBJECTIVES)
+        self.hm_collector = options.get('collector')
+        self.hm_mesh = options.get('mesh')
+        self.hm_mesh_axes = tuple(options.get('meshAxes', ('pools',)))
+        self.hm_shard = options.get('shard')
+        self.hm_interval = float(options.get('interval', 1000.0))
+        self.hm_table = options.get('table') or BackendTable()
+        self.hm_history: collections.deque = collections.deque(
+            maxlen=int(options.get('history', 64)))
+        self.hm_state: HealthState | None = None
+        self.hm_rows = 0
+        self.hm_last: dict | None = None
+        self.hm_started = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> 'HealthMonitor':
+        """Attach the table to the trace layer's completion sinks and
+        register on the module's active-monitor list (the /kang/health
+        and SIGUSR2 surfaces)."""
+        from .. import trace as mod_trace
+        if not self.hm_started:
+            mod_trace.add_backend_sink(self.hm_table)
+            with _MONITORS_LOCK:
+                _MONITORS.append(self)
+            self.hm_started = True
+        return self
+
+    def stop(self):
+        from .. import trace as mod_trace
+        if self.hm_started:
+            mod_trace.remove_backend_sink(self.hm_table)
+            with _MONITORS_LOCK:
+                if self in _MONITORS:
+                    _MONITORS.remove(self)
+            self.hm_started = False
+
+    # -- the tick ------------------------------------------------------
+
+    def _rows_for(self, n: int) -> int:
+        rows = 8
+        while rows < n:
+            rows *= 2
+        if self.hm_mesh is not None:
+            mult = int(self.hm_mesh.size)
+            rows = ((rows + mult - 1) // mult) * mult
+        return rows
+
+    def _pad_state(self, state: HealthState, rows: int) -> HealthState:
+        def pad(leaf):
+            if getattr(leaf, 'ndim', 0) == 0:
+                return leaf
+            widths = [(0, rows - leaf.shape[0])] + [
+                (0, 0)] * (leaf.ndim - 1)
+            return jnp.pad(leaf, widths)
+        return jax.tree.map(pad, state)
+
+    def tick(self, now_ms: float | None = None) -> dict:
+        """Drain the table, run one judged step, publish verdicts.
+        Returns the host-side record (also kept as ``hm_last``)."""
+        from .. import trace as mod_trace
+        from .. import utils as mod_utils
+        from .telemetry import fold_backend_slots
+        if now_ms is None:
+            now_ms = mod_utils.current_millis()
+        # The native recorder attributes lazily: completed claims sit
+        # in the C ring until a drain replays them into the sinks.
+        runtime = mod_trace._runtime
+        if runtime is not None:
+            runtime._drain_native()
+        cols = self.hm_table.drain()
+        rows = self._rows_for(len(cols['lat_sum']))
+        if self.hm_state is None or rows != self.hm_rows:
+            if self.hm_state is None:
+                state = health_init(rows)
+            else:
+                state = self._pad_state(self.hm_state, rows)
+            if self.hm_mesh is not None:
+                state = shard_health_state(state, self.hm_mesh,
+                                           self.hm_mesh_axes)
+            self.hm_state, self.hm_rows = state, rows
+
+        inp = health_inputs(
+            rows, now_ms=jnp.float32(now_ms % (2.0 ** 20)),
+            **fold_backend_slots(cols, rows))
+        if self.hm_mesh is not None:
+            inp = shard_health_inputs(inp, self.hm_mesh,
+                                      self.hm_mesh_axes)
+        step = make_health_step(self.hm_mesh, self.hm_mesh_axes,
+                                self.hm_objectives)
+        state = self.hm_state
+        self.hm_state = None      # donation: never reuse on failure
+        new_state, verdicts, fleet = step(state, inp)
+        self.hm_state = new_state
+
+        record = self._publish(verdicts, fleet, now_ms)
+        return record
+
+    def _publish(self, verdicts, fleet, now_ms: float) -> dict:
+        from .. import trace as mod_trace
+        import numpy as np
+        v = {k: np.asarray(x) for k, x in verdicts.items()}
+        f = {k: np.asarray(x).item() for k, x in fleet.items()}
+        backends = {}
+        for row in np.nonzero(np.asarray(v['gray']) |
+                              (v['ewma_ms'] > 0.0))[0]:
+            key = mod_trace.backend_key_for(int(row))
+            if key is None:
+                continue
+            backends[key or '(unattributed)'] = {
+                'gray': bool(v['gray'][row]),
+                'z': float(v['z'][row]),
+                'score': int(v['score'][row]),
+                'ewma_ms': float(v['ewma_ms'][row]),
+            }
+        record = {
+            'epoch': int(v['epoch']),
+            'at_ms': float(now_ms),
+            'backends': backends,
+            'gray': sorted(k for k, b in backends.items()
+                           if b['gray']),
+            'fleet': f,
+        }
+        self.hm_last = record
+        self.hm_history.append({
+            'epoch': record['epoch'], 'at_ms': record['at_ms'],
+            'gray': record['gray'], 'n_gray': int(f['n_gray']),
+            'burn_fast': float(f['burn_fast']),
+            'burn_slow': float(f['burn_slow']),
+            'alert_page': bool(f['alert_page']),
+            'alert_ticket': bool(f['alert_ticket']),
+        })
+
+        collector = self.hm_collector
+        if collector is None:
+            collector = mod_trace.active_collector()
+        if collector is not None:
+            shard = ({'shard': str(self.hm_shard)}
+                     if self.hm_shard is not None else {})
+            hg = _HEALTH_GAUGES
+            for key, b in backends.items():
+                labels = dict(shard, backend=key)
+                collector.gauge(
+                    'cueball_backend_health',
+                    hg['cueball_backend_health']).set(
+                        1.0 if b['gray'] else 0.0, labels)
+                collector.gauge(
+                    'cueball_backend_latency_ewma_ms',
+                    hg['cueball_backend_latency_ewma_ms']).set(
+                        b['ewma_ms'], labels)
+            for objective, fast, slow in (
+                    ('success', 'burn_fast', 'burn_slow'),):
+                collector.gauge(
+                    'cueball_slo_burn_rate',
+                    hg['cueball_slo_burn_rate']).set(
+                        f[fast], dict(shard, objective=objective,
+                                      window='fast'))
+                collector.gauge(
+                    'cueball_slo_burn_rate',
+                    hg['cueball_slo_burn_rate']).set(
+                        f[slow], dict(shard, objective=objective,
+                                      window='slow'))
+        return record
+
+    # -- surfaces ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The /kang/health JSON row for this monitor."""
+        return {
+            'objectives': {
+                'success_target': self.hm_objectives.success_target,
+                'claim_p99_ms': self.hm_objectives.claim_p99_ms,
+            },
+            'shard': self.hm_shard,
+            'interval_ms': self.hm_interval,
+            'last': self.hm_last,
+            'history': list(self.hm_history),
+        }
+
+
+def active_monitors() -> list:
+    """Every started HealthMonitor in this process (newest last)."""
+    with _MONITORS_LOCK:
+        return list(_MONITORS)
+
+
+def health_snapshot() -> dict:
+    """The GET /kang/health payload: one row per active monitor plus
+    the fleet merge (same shape reduce_health hands the router)."""
+    monitors = active_monitors()
+    return {
+        'n_monitors': len(monitors),
+        'monitors': [m.snapshot() for m in monitors],
+        'fleet': reduce_health([m.hm_last for m in monitors]),
+    }
+
+
+def reduce_health(records) -> dict:
+    """Combine per-shard health records (HealthMonitor.tick dicts)
+    into one fleet row: gray sets union, counts sum, rates combine
+    weighted by ops, burn rates and p99 take the worst shard."""
+    records = [r for r in records if r]
+    out = {'n_backends': 0, 'n_gray': 0, 'gray': [],
+           'ops': 0, 'errors': 0, 'shed': 0, 'err_rate': 0.0,
+           'claim_p99_ms': 0.0, 'burn_fast': 0.0, 'burn_slow': 0.0,
+           'alert_page': False, 'alert_ticket': False}
+    if not records:
+        return out
+    gray: set = set()
+    tot_ops = sum(int(r['fleet']['ops']) for r in records)
+    safe = float(tot_ops) if tot_ops > 0 else 1.0
+    for r in records:
+        f = r['fleet']
+        gray.update(r.get('gray', ()))
+        out['n_backends'] += int(f['n_backends'])
+        for k in ('ops', 'errors', 'shed'):
+            out[k] += int(f[k])
+        out['err_rate'] += float(f['err_rate']) * int(f['ops']) / safe
+        out['claim_p99_ms'] = max(out['claim_p99_ms'],
+                                  float(f['claim_p99_ms']))
+        out['burn_fast'] = max(out['burn_fast'], float(f['burn_fast']))
+        out['burn_slow'] = max(out['burn_slow'], float(f['burn_slow']))
+        out['alert_page'] |= bool(f['alert_page'])
+        out['alert_ticket'] |= bool(f['alert_ticket'])
+    out['gray'] = sorted(gray)
+    out['n_gray'] = len(gray)
+    return out

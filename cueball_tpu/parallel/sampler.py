@@ -221,6 +221,14 @@ class FleetSampler:
       inside the FIR warm-up window (< taps ticks) are not offered
       decisions. The tick record gains a ``control`` entry with the
       fleet row, apply counts and the decision columns.
+    - health: run the fleet health engine (parallel.health) after
+      every telemetry tick. The sampler owns a HealthMonitor (fed by
+      the claim tracer's per-backend sinks) and ticks it in step with
+      the fleet, so per-backend gray verdicts and SLO burn rates land
+      on the same collector, /kang/health and the SIGUSR2 dump. The
+      tick record gains a ``health`` entry with the verdict record.
+    - objectives: an SLOObjectives for the health engine (default
+      parallel.health.DEFAULT_OBJECTIVES; ignored without `health`).
     """
 
     def __init__(self, options: dict | None = None):
@@ -247,6 +255,9 @@ class FleetSampler:
         self.fs_ctrl_state = None              # ControlState (lazy)
         self.fs_ctrl_step = None               # jitted control step
         self.fs_ctrl_last: dict | None = None  # last control record
+        self.fs_health = bool(options.get('health'))
+        self.fs_objectives = options.get('objectives')
+        self.fs_health_monitor = None          # HealthMonitor (lazy)
 
         self.fs_epoch = mod_utils.current_millis()
         self.fs_rows: dict[str, int] = {}      # pool uuid -> row
@@ -292,6 +303,9 @@ class FleetSampler:
         if self.fs_timer is not None:
             self.fs_timer.cancel()
             self.fs_timer = None
+        if self.fs_health_monitor is not None:
+            self.fs_health_monitor.stop()
+            self.fs_health_monitor = None
 
     # -- row management --------------------------------------------------
 
@@ -655,6 +669,8 @@ class FleetSampler:
                   'fleet': fleet_np, 'pools': per_pool}
         if self.fs_control:
             record['control'] = self._control_once(inp, out, abs_now)
+        if self.fs_health:
+            record['health'] = self._health_once(abs_now)
         if self.fs_record:
             # History must be plain data — a lazy view per retained
             # tick would pin every tick's column copies anyway, and
@@ -730,7 +746,21 @@ class FleetSampler:
         eligible = {row: pool
                     for row, pool in self.fs_row_pool.items()
                     if self.fs_row_ticks.get(row, 0) >= self.fs_taps}
-        summary = apply_decisions(eligible, dec_np, at_ms=abs_now)
+        # Health citation: the verdict the control plane saw when it
+        # decided. The health tick runs after control within a sample,
+        # so the citation is the previous tick's (the freshest verdict
+        # that could actually have informed this decision).
+        health = None
+        if self.fs_health and self.fs_health_monitor is not None:
+            last = self.fs_health_monitor.hm_last
+            if last is not None:
+                health = {'epoch': last['epoch'],
+                          'at_ms': last['at_ms'],
+                          'gray': list(last['gray']),
+                          'burn_fast': last['fleet']['burn_fast'],
+                          'burn_slow': last['fleet']['burn_slow']}
+        summary = apply_decisions(eligible, dec_np, at_ms=abs_now,
+                                  health=health)
         record = {'fleet': fleet_np, 'decisions': dec_np,
                   'step_ms': mod_utils.current_millis() - t0}
         record.update(summary)
@@ -751,6 +781,31 @@ class FleetSampler:
                 collector.gauge('cueball_control_' + name, help_).set(
                     float(vals[name]), labels)
         return record
+
+    # -- health plane ----------------------------------------------------
+
+    def _ensure_health(self):
+        from .health import HealthMonitor
+        if self.fs_health_monitor is None:
+            opts = {'collector': self.fs_collector,
+                    'shard': self.fs_shard,
+                    'interval': self.fs_interval}
+            if self.fs_mesh is not None:
+                opts['mesh'] = self.fs_mesh
+                opts['meshAxes'] = self.fs_mesh_axes
+            if self.fs_objectives is not None:
+                opts['objectives'] = self.fs_objectives
+            # start() attaches the monitor's BackendTable to the claim
+            # tracer's completion sinks and registers it on the
+            # /kang/health + SIGUSR2 surfaces.
+            self.fs_health_monitor = HealthMonitor(opts).start()
+        return self.fs_health_monitor
+
+    def _health_once(self, abs_now: float) -> dict:
+        """Tick the owned HealthMonitor in step with the fleet tick:
+        drain the per-backend attribution columns, run one judged
+        health step, publish the verdict record."""
+        return self._ensure_health().tick(abs_now)
 
     # -- kang integration ------------------------------------------------
 
@@ -783,6 +838,13 @@ class FleetSampler:
                     'step_ms': last['step_ms'],
                 },
             }
+        health = None
+        if self.fs_health:
+            mon = self.fs_health_monitor
+            health = {
+                'enabled': True,
+                'monitor': None if mon is None else mon.snapshot(),
+            }
         return {
             'interval_ms': self.fs_interval,
             'shard': self.fs_shard,
@@ -791,6 +853,7 @@ class FleetSampler:
             'rows': dict(self.fs_rows),
             'actuate': self.fs_actuate,
             'control': control,
+            'health': health,
             'mesh': mesh,
             'row_ticks': dict(self.fs_row_ticks),
             'last_tick_visits': self.fs_tick_visits,

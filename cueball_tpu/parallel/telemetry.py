@@ -426,3 +426,24 @@ def shard_inputs(inp: FleetInputs, mesh: Mesh,
                  axes: tuple = ('pools',)) -> FleetInputs:
     _, input_shardings, _ = _step_shardings(mesh, axes)
     return jax.tree.map(jax.device_put, inp, input_shardings)
+
+
+def fold_backend_slots(cols: dict, rows: int) -> dict:
+    """Fold drained per-backend slot columns into step-shaped arrays.
+
+    ``cols`` is a BackendTable drain (parallel.health): host numpy
+    columns indexed by backend row — rank-1 latency/error/shed
+    accumulators and rank-2 ``*_buckets`` sketches. The backend axis
+    pads out to ``rows`` (the health step's power-of-two,
+    mesh-multiple capacity); padding rows are all-zero and inactive,
+    so they drop out of every judged reduction. The bucket axis of
+    rank-2 columns is fixed geometry and never pads."""
+    import numpy as np
+    out = {}
+    for name, col in cols.items():
+        pad = rows - len(col)
+        if col.ndim == 1:
+            out[name] = np.pad(col, (0, pad))
+        else:
+            out[name] = np.pad(col, ((0, pad), (0, 0)))
+    return out

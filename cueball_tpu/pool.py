@@ -283,6 +283,10 @@ class ConnectionPool(FSM):
         self.p_control_actuation = bool(options.get('controlActuation'))
         self.p_ctrl_epoch = 0
         self.p_ctrl_at = -math.inf
+        # Audit trail of the last accepted decision's health citation
+        # (the fleet health verdict the control plane saw when it
+        # decided): None until a decision carrying one is accepted.
+        self.p_ctrl_health: dict | None = None
 
         # Fleet-telemetry push handles (see FleetSampler): a tuple so
         # the per-event dirty mark is a plain iteration — empty for the
@@ -366,7 +370,8 @@ class ConnectionPool(FSM):
     setMaximum = set_maximum
 
     def apply_control_decision(self, epoch: int, codel_target=None,
-                               spares=None, at_ms=None) -> bool:
+                               spares=None, at_ms=None,
+                               health=None) -> bool:
         """Guarded control-plane actuation: accept one decision row
         from the fused control step (parallel.control).
 
@@ -386,8 +391,12 @@ class ConnectionPool(FSM):
         On accept, only the values that actually moved are applied:
         the CoDel target via the guarded ``set_target`` and the spares
         setting via the same dirty-mark + rebalance path as
-        ``set_spares``. Cost when the control plane is idle: zero —
-        nothing on the claim path reads any of this."""
+        ``set_spares``. ``health`` (when given with an accepted
+        decision) is kept verbatim as ``p_ctrl_health`` — the fleet
+        health verdict the control plane cited, so a SIGUSR2 dump or
+        kang snapshot can answer "what did the controller believe when
+        it moved this pool". Cost when the control plane is idle:
+        zero — nothing on the claim path reads any of this."""
         if not self.p_control_actuation:
             return False
         now = at_ms if at_ms is not None else mod_utils.current_millis()
@@ -412,6 +421,8 @@ class ConnectionPool(FSM):
         # Validation complete; apply.
         self.p_ctrl_epoch = epoch
         self.p_ctrl_at = now
+        if health is not None:
+            self.p_ctrl_health = health
         if codel_target is not None and \
                 codel_target != self.p_codel.cd_targdelay:
             self.p_codel.set_target(codel_target)

@@ -390,7 +390,7 @@ class FleetRouter:
         pools = {}
         for name, rec in sorted(self.fr_pools.items()):
             pools[name] = {'shard': rec.shard_id, 'key': rec.key}
-        return {
+        snap = {
             'backend': self.fr_backend,
             'nshards': self.fr_nshards,
             'seed': self.fr_seed,
@@ -399,6 +399,16 @@ class FleetRouter:
                         for k, v in sorted(self.fr_submits.items())},
             'pools': pools,
         }
+        # Merged health verdicts, when any shard sampler runs the
+        # health plane. Reading hm_last cross-thread is safe: ticks
+        # rebind the record wholesale, never mutate it in place.
+        verdicts = [s.fs_health_monitor.hm_last
+                    for s in self.fr_samplers.values()
+                    if s.fs_health_monitor is not None]
+        if any(v is not None for v in verdicts):
+            from ..parallel.health import reduce_health
+            snap['health'] = reduce_health(verdicts)
+        return snap
 
     def attach_metrics(self, collector) -> None:
         """Publish per-shard gauges (shard-labelled) on ``collector``
@@ -479,6 +489,41 @@ class FleetRouter:
                 records.append(rec)
         from ..parallel.control import reduce_control
         return reduce_control(records)
+
+    def _health_shard(self, shard_id: int):
+        # Runs inside the shard loop: the shard's sampler gains the
+        # health plane if it didn't have it and ticks once; the
+        # HealthMonitor drains the claim tracer's attribution columns
+        # and judges them on this loop.
+        sampler = self.fr_samplers.get(shard_id)
+        if sampler is None:
+            from ..parallel.sampler import FleetSampler
+            sampler = FleetSampler({'shard': shard_id, 'health': True})
+            self.fr_samplers[shard_id] = sampler
+        else:
+            sampler.fs_health = True
+        rec = sampler.sample_once()
+        return rec.get('health') if rec else None
+
+    async def health_fleet(self):
+        """One health pass: each running shard ticks its HealthMonitor
+        on its own loop, then the per-shard verdict records merge
+        shard->host with :func:`parallel.health.reduce_health` (gray
+        sets union, burn rates take the worst shard). Not offered for
+        the spawn backend (children judge their own backends)."""
+        if self.fr_backend == 'spawn':
+            raise CueBallError(
+                'health_fleet is not available on the spawn backend; '
+                'children run their own health monitors')
+        records = []
+        for sid, fsm in sorted(self.fr_fsms.items()):
+            if not fsm.is_in_state('running'):
+                continue
+            rec = await self.run_on(sid, self._health_shard, sid)
+            if rec:
+                records.append(rec)
+        from ..parallel.health import reduce_health
+        return reduce_health(records)
 
     async def sample_fleet(self, mesh=None, mesh_axes=('host', 'chip')):
         """One per-shard FleetSampler pass each on its own loop, then

@@ -140,8 +140,77 @@ def get_shard_id() -> int | None:
 
 
 def _shard_from_flags(flags: int) -> int | None:
-    sid = (int(flags) >> _SHARD_FLAG_SHIFT) - 1
+    sid = ((int(flags) >> _SHARD_FLAG_SHIFT) & 0xFFF) - 1
     return sid if sid >= 0 else None
+
+
+# -- backend identity -------------------------------------------------------
+# Stable small integers for backend keys, shared by every attribution
+# surface: the native recorder stamps the index into slot flags (bits
+# 20+, biased by +1 like the shard field) and the health engine's
+# BackendTable uses the same index as its row number, so a claim
+# attributed by the C ring and one attributed by the Python recorder
+# land in the same per-backend column. Index 0 is RESERVED for the
+# unattributed bucket (key ''): claims that never reached a backend.
+
+_BACKEND_LOCK = threading.Lock()
+_BACKEND_KEYS: list = ['']
+_BACKEND_IDS: dict = {'': 0}
+_BACKEND_FLAG_SHIFT = 20
+#: 12 flag bits, biased by +1: indexes past this fall back to row 0.
+BACKEND_INDEX_MAX = 0xFFE
+
+
+def backend_index(key) -> int:
+    """The stable row index for a backend key (registering it on first
+    sight). Falls back to 0 (unattributed) when the registry is full,
+    so the flag stamp can never alias two real backends."""
+    key = str(key or '')
+    idx = _BACKEND_IDS.get(key)
+    if idx is not None:
+        return idx
+    with _BACKEND_LOCK:
+        idx = _BACKEND_IDS.get(key)
+        if idx is None:
+            if len(_BACKEND_KEYS) > BACKEND_INDEX_MAX:
+                return 0
+            idx = len(_BACKEND_KEYS)
+            _BACKEND_KEYS.append(key)
+            _BACKEND_IDS[key] = idx
+    return idx
+
+
+def backend_key_for(index: int) -> str | None:
+    """Reverse lookup; None for indexes never registered."""
+    if not 0 <= index < len(_BACKEND_KEYS):
+        return None
+    return _BACKEND_KEYS[index]
+
+
+def _backend_from_flags(flags: int) -> str | None:
+    idx = ((int(flags) >> _BACKEND_FLAG_SHIFT) & 0xFFF) - 1
+    return backend_key_for(idx) if idx >= 0 else None
+
+
+# Attribution sinks (the health engine's BackendTable): every finished
+# claim and every CoDel shed is offered to each sink with its backend
+# key, on whatever thread completed it. Copy-on-write tuple like
+# _EXPORT_SOURCES so the hot path pays one load when empty.
+_BACKEND_SINKS: tuple = ()
+
+
+def add_backend_sink(sink) -> None:
+    """Register an attribution sink: an object with
+    ``observe(key, service_ms, claim_ms, ok)`` and
+    ``observe_shed(key)``."""
+    global _BACKEND_SINKS
+    _BACKEND_SINKS = _BACKEND_SINKS + (sink,)
+
+
+def remove_backend_sink(sink) -> None:
+    global _BACKEND_SINKS
+    _BACKEND_SINKS = tuple(
+        s for s in _BACKEND_SINKS if s is not sink)
 
 
 # External NDJSON producers merged into export_ndjson() — the seam the
@@ -303,7 +372,8 @@ class ClaimTrace(Trace):
     guarded method per FSM transition; every method tolerates arriving
     in unexpected orders (terminal states finish idempotently)."""
 
-    __slots__ = ('ct_queue_span', 'ct_handshake_span', 'ct_lease_span')
+    __slots__ = ('ct_queue_span', 'ct_handshake_span', 'ct_lease_span',
+                 'ct_backend')
 
     root_name = 'claim'
 
@@ -343,6 +413,7 @@ class ClaimTrace(Trace):
                                              start=self.root.start)
         self.ct_handshake_span = None
         self.ct_lease_span = None
+        self.ct_backend = ''
 
     def codel_decision(self, decision: str, sojourn_ms: float,
                        target_ms: float, now: float | None = None) -> None:
@@ -377,6 +448,7 @@ class ClaimTrace(Trace):
 
     def _claiming_at(self, backend: str, last: tuple | None,
                      now: float) -> None:
+        self.ct_backend = backend or ''
         self.end_span(self.ct_queue_span, now)
         if last is not None:
             cstart, cend = last
@@ -618,6 +690,13 @@ class _TraceRuntime:
             self.tr_collector.counter(SHED_COUNTER, help=SHED_HELP) \
                 .increment({'reason': reason})
         trace = getattr(handle, 'ch_trace', None)
+        sinks = _BACKEND_SINKS
+        if sinks:
+            # Sheds strike queued claims, so most are unattributed
+            # (row 0); a requeued claim keeps its last backend.
+            key = getattr(trace, 'ct_backend', '') or ''
+            for sink in sinks:
+                sink.observe_shed(key)
         if trace is not None:
             trace.codel_decision('shed-' + reason, sojourn_ms, target_ms)
 
@@ -643,6 +722,18 @@ class _TraceRuntime:
         if len(self.tr_ring) == self.tr_ring.maxlen:
             self.tr_evicted += 1
         self.tr_ring.append(trace)
+        sinks = _BACKEND_SINKS
+        if sinks and isinstance(trace, ClaimTrace):
+            outcome = trace.root.attrs.get('outcome')
+            if outcome != 'cancelled':
+                lease = trace.ct_lease_span
+                service = (lease.duration()
+                           if lease is not None else None)
+                ok = outcome in ('released', 'closed')
+                claim_ms = trace.root.duration()
+                for sink in sinks:
+                    sink.observe(trace.ct_backend, service,
+                                 claim_ms, ok)
         if self.tr_collector is None:
             return
         totals = trace.span_totals()
@@ -699,6 +790,15 @@ class _TraceRuntime:
                 ent = pending.get(serial)
                 if ent is None:
                     self.tr_truncated += 1
+                    # The begin slot was overwritten, but terminal
+                    # claim events still carry the backend index in
+                    # their flags: attribution survives truncation.
+                    sinks = _BACKEND_SINKS
+                    if sinks and code in (_EV_RELEASED, _EV_FAILED):
+                        key = _backend_from_flags(flags) or ''
+                        for sink in sinks:
+                            sink.observe(key, None, None,
+                                         code == _EV_RELEASED)
                     continue
                 trace = ent[0]
                 if code == _EV_CODEL:
@@ -928,6 +1028,47 @@ def export_ndjson() -> str:
         if extra:
             out += extra if extra.endswith('\n') else extra + '\n'
     return out
+
+
+def filter_ndjson(text: str, limit: int | None = None,
+                  backend: str | None = None) -> str:
+    """Filter an NDJSON span export by trace: keep only traces with at
+    least one span attributed to `backend` (handshake/connect spans
+    carry attrs.backend), then only the LAST `limit` traces — newest
+    claims are what an operator chasing a flagged backend wants. With
+    neither filter the text passes through untouched (the default
+    /kang/traces behaviour, byte-identical to the pre-filter surface).
+    Whole traces are kept or dropped; span lines are never split up."""
+    if not text or (limit is None and backend is None):
+        return text
+    groups: dict = {}
+    order: list = []
+    matched: set = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        try:
+            span = json.loads(line)
+            tid = span.get('trace_id')
+        except ValueError:
+            tid = None
+        if tid is None:
+            continue
+        if tid not in groups:
+            groups[tid] = []
+            order.append(tid)
+        groups[tid].append(line)
+        attrs = span.get('attrs')
+        if backend is not None and isinstance(attrs, dict) and \
+                attrs.get('backend') == backend:
+            matched.add(tid)
+    if backend is not None:
+        order = [tid for tid in order if tid in matched]
+    if limit is not None:
+        limit = max(int(limit), 0)
+        order = order[len(order) - limit:] if limit else []
+    lines = [line for tid in order for line in groups[tid]]
+    return '\n'.join(lines) + '\n' if lines else ''
 
 
 # Identity of the current netsim scenario run (seed, name, schedule),

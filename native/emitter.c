@@ -2281,18 +2281,34 @@ static PyObject *trace_clock_fn = NULL;
 static _Thread_local int trace_tls_shard = -1;
 
 #define TRACE_SHARD_FLAG_SHIFT 8
+#define TRACE_BACKEND_FLAG_SHIFT 20
 
 static inline uint32_t
 trace_shard_flags(void)
 {
     return trace_tls_shard < 0
         ? 0u
-        : ((uint32_t)(trace_tls_shard + 1)) << TRACE_SHARD_FLAG_SHIFT;
+        : (((uint32_t)(trace_tls_shard + 1)) & 0xFFFu)
+            << TRACE_SHARD_FLAG_SHIFT;
+}
+
+/* Backend identity of a claim token (trace.backend_index, read off the
+   serving socket manager at claiming time), stamped into every later
+   slot's flags at bits 20+ biased by +1 — so a terminal event whose
+   begin slot was overwritten still attributes to the right backend's
+   health column. */
+static inline uint32_t
+trace_backend_flags(int idx)
+{
+    return idx < 0
+        ? 0u
+        : (((uint32_t)(idx + 1)) & 0xFFFu) << TRACE_BACKEND_FLAG_SHIFT;
 }
 
 static PyObject *str_get_socket_mgr;
 static PyObject *str_csf_smgr;
 static PyObject *str_sm_backend;
+static PyObject *str_sm_backend_index;
 static PyObject *str_sm_last_connect;
 static PyObject *str_key;
 static PyObject *str_get;
@@ -2474,6 +2490,7 @@ typedef struct {
     PyObject_HEAD
     uint64_t nt_serial;
     int nt_queries;
+    int nt_backend;   /* trace.backend_index; -1 = unattributed */
 } NTraceObject;
 
 static PyTypeObject NTrace_Type;
@@ -2510,6 +2527,7 @@ ntrace_new_token(void)
     }
     nt->nt_serial = trace_serial_next++;
     nt->nt_queries = 0;
+    nt->nt_backend = -1;
     return nt;
 }
 
@@ -2633,6 +2651,24 @@ NTrace_claiming(NTraceObject *self, PyObject *slot)
             }
             Py_DECREF(be);
         }
+        /* connection_fsm caches trace.backend_index on the manager;
+           duck-typed fakes without it simply stay unattributed. */
+        PyObject *bi = PyObject_GetAttr(smgr, str_sm_backend_index);
+        if (bi == NULL) {
+            if (!PyErr_ExceptionMatches(PyExc_AttributeError))
+                goto fail;
+            PyErr_Clear();
+        } else {
+            if (bi != Py_None) {
+                long v = PyLong_AsLong(bi);
+                if (v == -1 && PyErr_Occurred()) {
+                    Py_DECREF(bi);
+                    goto fail;
+                }
+                self->nt_backend = (int)v;
+            }
+            Py_DECREF(bi);
+        }
         PyObject *last = PyObject_GetAttr(smgr, str_sm_last_connect);
         if (last == NULL) {
             if (!PyErr_ExceptionMatches(PyExc_AttributeError))
@@ -2672,6 +2708,7 @@ NTrace_claiming(NTraceObject *self, PyObject *slot)
         Py_INCREF(str_empty);
         backend = str_empty;
     }
+    flags |= trace_backend_flags(self->nt_backend);
     trace_emit(self->nt_serial, TREV_CLAIMING, flags, now, cstart, cend,
                backend);
     Py_RETURN_NONE;
@@ -2691,7 +2728,9 @@ NTrace_claimed(NTraceObject *self, PyObject *noargs)
     double now = trace_now(&err);
     if (err)
         return NULL;
-    trace_emit(self->nt_serial, TREV_CLAIMED, 0, now, 0.0, 0.0, NULL);
+    trace_emit(self->nt_serial, TREV_CLAIMED,
+               trace_backend_flags(self->nt_backend), now, 0.0, 0.0,
+               NULL);
     Py_RETURN_NONE;
 }
 
@@ -2705,7 +2744,9 @@ NTrace_requeued(NTraceObject *self, PyObject *noargs)
     double now = trace_now(&err);
     if (err)
         return NULL;
-    trace_emit(self->nt_serial, TREV_REQUEUED, 0, now, 0.0, 0.0, NULL);
+    trace_emit(self->nt_serial, TREV_REQUEUED,
+               trace_backend_flags(self->nt_backend), now, 0.0, 0.0,
+               NULL);
     Py_RETURN_NONE;
 }
 
@@ -2719,7 +2760,9 @@ NTrace_released(NTraceObject *self, PyObject *how)
     if (err)
         return NULL;
     Py_INCREF(how);
-    trace_emit(self->nt_serial, TREV_RELEASED, 0, now, 0.0, 0.0, how);
+    trace_emit(self->nt_serial, TREV_RELEASED,
+               trace_backend_flags(self->nt_backend), now, 0.0, 0.0,
+               how);
     Py_RETURN_NONE;
 }
 
@@ -2739,7 +2782,9 @@ NTrace_failed(NTraceObject *self, PyObject *errobj)
         if (name == NULL)
             return NULL;
     }
-    trace_emit(self->nt_serial, TREV_FAILED, 0, now, 0.0, 0.0, name);
+    trace_emit(self->nt_serial, TREV_FAILED,
+               trace_backend_flags(self->nt_backend), now, 0.0, 0.0,
+               name);
     Py_RETURN_NONE;
 }
 
@@ -3137,6 +3182,8 @@ PyInit__cueball_native(void)
             PyUnicode_InternFromString("csf_smgr")) == NULL ||
         (str_sm_backend =
             PyUnicode_InternFromString("sm_backend")) == NULL ||
+        (str_sm_backend_index =
+            PyUnicode_InternFromString("sm_backend_index")) == NULL ||
         (str_sm_last_connect =
             PyUnicode_InternFromString("sm_last_connect")) == NULL ||
         (str_key = PyUnicode_InternFromString("key")) == NULL ||

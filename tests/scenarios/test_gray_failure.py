@@ -13,13 +13,39 @@ Envelope, p99-style like test_pool_codel's ±175ms pin:
   queued behind a gray lease waits for it) but must not collapse the
   pool;
 - overall success rate stays >= 99%: gray is slow, not down.
+
+The detector arm (parallel.health) rides the same scenario: claim
+traces attribute per backend, a HealthMonitor ticks on the virtual
+clock, and the envelope is that it NAMES exactly the seeded gray
+backends — zero false positives across seeds — while every other
+control surface still reads healthy (no dead set, no failed claims:
+the whole point of gray failure).
 """
+
+import asyncio
+import json
 
 import pytest
 
 from cueball_tpu import netsim
+from cueball_tpu import trace as mod_trace
+from cueball_tpu.netsim import scenario as mod_scenario
 
 import scenario_common as sco
+
+
+class _ClaimCounts:
+    """Backend-sink that only counts attributed claims (picks the
+    traffic carriers to turn gray, so the detector has signal)."""
+
+    def __init__(self):
+        self.counts = {}
+
+    def observe(self, key, service_ms, claim_ms, ok):
+        self.counts[key] = self.counts.get(key, 0) + 1
+
+    def observe_shed(self, key):
+        pass
 
 
 @pytest.mark.parametrize('seed', [5, 909])
@@ -62,3 +88,154 @@ def test_gray_failure_p99_claim_latency_envelope(seed):
     # straight through this.
     assert p99 < 450.0, (ok_rate, p50, p99)
     assert len(sc.trace) > 100
+
+
+@pytest.mark.parametrize('seed', [5, 17, 23, 42, 909])
+def test_gray_detector_names_seeded_backends_zero_false_positives(seed):
+    """The health detector names exactly the seeded gray backends.
+
+    Gray selection is informed: at t=2s the two busiest backends (by
+    attributed claim count) turn 100x slow, so the detector is
+    guaranteed observable signal. The envelope:
+
+    - every backend the detector EVER flags is a seeded one (zero
+      false positives, all ticks, all seeds);
+    - both seeded backends are flagged within 5s virtual of onset;
+    - at first detection the classic control surfaces still read
+      healthy — empty dead set, no failed claims — i.e. the detector
+      reacts before any other arm can.
+    """
+    from cueball_tpu.parallel import health as H
+
+    fabric = netsim.Fabric()
+    sc = netsim.Scenario('gray-detector', seed=seed)
+    result = {'ticks': [], 'gray_keys': None, 'detected_at': None,
+              'dead_at_detect': None}
+    counts = _ClaimCounts()
+
+    async def tick_loop(monitor, pool, loop):
+        while True:
+            rec = monitor.tick()
+            result['ticks'].append((loop.time(), tuple(rec['gray'])))
+            if rec['gray'] and result['detected_at'] is None:
+                result['detected_at'] = loop.time()
+                result['dead_at_detect'] = sorted(pool.p_dead)
+            await asyncio.sleep(0.25)
+
+    def go_gray(pool):
+        # The two busiest attributed backends turn gray; remember
+        # their pool keys (what the detector reports) and drive the
+        # fabric by alias (address:port).
+        if mod_trace._runtime is not None:
+            mod_trace._runtime._drain_native()
+        busiest = sorted(counts.counts, key=counts.counts.get,
+                         reverse=True)[:2]
+        aliases = ['%s:%s' % (pool.p_backends[k]['address'],
+                              pool.p_backends[k]['port'])
+                   for k in busiest]
+        fabric.set_gray(aliases, mult=100.0)
+        result['gray_keys'] = sorted(busiest)
+
+    async def main():
+        mod_trace.enable_tracing(ring_size=2048, sample_rate=1.0)
+        mod_trace.add_backend_sink(counts)
+        backends = sco.region_backends(regions=1, per_region=10)
+        for b in backends:
+            fabric.set_link(sco.fabric_key(b), service_ms=2.0)
+        pool, res = sco.make_sim_pool(fabric, backends, spares=6,
+                                      maximum=10)
+        await sco.wait_state(pool, 'running', timeout_s=10.0)
+        loop = asyncio.get_running_loop()
+
+        monitor = H.HealthMonitor({'interval': 250}).start()
+        ticker = asyncio.ensure_future(tick_loop(monitor, pool, loop))
+        sc.at(2.0, 'gray-busiest-2', lambda: go_gray(pool))
+        try:
+            outcomes = await netsim.herd(
+                pool, 400, rate_per_s=40.0, timeout_ms=2000)
+            result['outcomes'] = outcomes
+        finally:
+            ticker.cancel()
+            monitor.stop()
+            mod_trace.remove_backend_sink(counts)
+        await sco.stop_pool(pool, res)
+
+    try:
+        sc.run(lambda: main())
+    finally:
+        mod_trace.disable_tracing()
+
+    seeded = set(result['gray_keys'])
+    assert len(seeded) == 2
+    flagged_ever = set()
+    for t, gray in result['ticks']:
+        flagged_ever.update(gray)
+        # Zero false positives: nothing outside the seeded set, ever
+        # (in particular: nothing at all before the fault fires).
+        assert set(gray) <= seeded, (t, gray, sorted(seeded))
+    assert flagged_ever == seeded, (sorted(flagged_ever),
+                                    sorted(seeded))
+    # Detection envelope: named within 5s virtual of onset, with >= 3
+    # judged ticks of hysteresis in between (streak gate).
+    assert result['detected_at'] is not None
+    assert 2.0 < result['detected_at'] <= 7.0, result['detected_at']
+    # The detector fired while every other arm still read healthy.
+    assert result['dead_at_detect'] == []
+    pre_detect = [r for r in result['outcomes']
+                  if r['t_arrive_s'] <= result['detected_at']]
+    assert pre_detect and all(r['ok'] for r in pre_detect)
+    ok_rate = (sum(1 for r in result['outcomes'] if r['ok'])
+               / len(result['outcomes']))
+    assert ok_rate >= 0.99, ok_rate
+
+
+def test_failure_dump_embeds_health_verdict_history(
+        tmp_path, monkeypatch):
+    """A scenario that breaks its envelope while a HealthMonitor is
+    live writes the verdict history into the replay dump."""
+    from cueball_tpu.parallel import health as H
+
+    monkeypatch.setenv(mod_scenario.DUMP_DIR_ENV, str(tmp_path))
+    fabric = netsim.Fabric()
+    sc = netsim.Scenario('gray-dump', seed=5)
+    held = {}
+
+    async def main():
+        mod_trace.enable_tracing(ring_size=256, sample_rate=1.0)
+        backends = sco.region_backends(regions=1, per_region=4)
+        for b in backends:
+            fabric.set_link(sco.fabric_key(b), service_ms=2.0)
+        pool, res = sco.make_sim_pool(fabric, backends, spares=2,
+                                      maximum=4)
+        await sco.wait_state(pool, 'running', timeout_s=10.0)
+        # Deliberately NOT stopped before the raise: the monitor must
+        # still be active when _dump_failure runs, exactly as in a
+        # real envelope break mid-scenario.
+        held['monitor'] = H.HealthMonitor().start()
+        try:
+            for _ in range(5):
+                assert await sco.claim_release(pool, timeout_ms=1000)
+                await asyncio.sleep(0.1)
+            held['monitor'].tick()
+            raise AssertionError('forced envelope break')
+        finally:
+            await sco.stop_pool(pool, res)
+
+    try:
+        with pytest.raises(AssertionError, match='forced envelope'):
+            sc.run(lambda: main())
+    finally:
+        if 'monitor' in held:
+            held['monitor'].stop()
+        mod_trace.disable_tracing()
+
+    with open(tmp_path / 'gray-dump-seed5.json') as f:
+        dump = json.load(f)
+    assert 'health' in dump, sorted(dump)
+    history = dump['health']['history']
+    assert history and history[0], history
+    entry = history[0][-1]
+    for field in ('epoch', 'gray', 'burn_fast', 'burn_slow',
+                  'alert_page'):
+        assert field in entry, entry
+    assert dump['health']['fleet'] is not None

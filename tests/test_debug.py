@@ -278,3 +278,58 @@ def test_fsm_line_survives_broken_objects():
             raise RuntimeError('nope')
     line = mod_debug._fsm_line('x', Broken())
     assert 'state=?' in line
+
+
+def _spawn_dump_pool():
+    """Spawn-child pool factory ('test_debug:_spawn_dump_pool'): must
+    be module-level so the child process can import it by spec."""
+    return build_pool()
+
+
+def test_dump_renders_spawn_router_and_health_with_dead_child():
+    """SIGUSR2 dump while a spawn-backend FleetRouter is live: the
+    fleet_router section (shard FSM states + pool->shard tags) and the
+    new health section render from parent-side state only — killing a
+    child outright must not hang or break the dump."""
+    import time as mod_time
+
+    from cueball_tpu.parallel import health as mod_health
+    from cueball_tpu.shard import FleetRouter
+
+    async def main():
+        router = FleetRouter({'shards': 2, 'backend': 'spawn'})
+        await router.start(timeout_s=60.0)
+        monitor = None
+        try:
+            rec = await router.create_pool(
+                'svc.dump', factory='test_debug:_spawn_dump_pool')
+            # A health monitor with one judged tick, so the dump's
+            # health section has a verdict line to render.
+            monitor = mod_health.HealthMonitor().start()
+            monitor.hm_table.observe('spawn-b0', 5.0, 6.0, True)
+            monitor.tick()
+
+            # Kill the OTHER shard's child dead — no stop handshake.
+            dead = 1 - rec.shard_id
+            router.fr_workers[dead]._proc.terminate()
+            router.fr_workers[dead]._proc.join(timeout=10)
+
+            t0 = mod_time.monotonic()
+            report = cb.dump_fsm_histories()
+            # Parent-side state only: never an IPC round-trip, so the
+            # dump returns fast even with a corpse in the fleet.
+            assert mod_time.monotonic() - t0 < 2.0
+            assert 'fleet_router backend=spawn shards=2' in report
+            assert 'shard 0' in report and 'shard 1' in report
+            assert re.search(
+                r'pool svc\.dump\s+-> shard %d' % rec.shard_id, report)
+            assert '-- fleet health (1 monitor(s)) --' in report
+            assert re.search(r'epoch=1 backends=\d+ gray=-', report)
+        finally:
+            if monitor is not None:
+                monitor.stop()
+            try:
+                await router.stop()
+            except Exception:
+                pass    # a terminated child may fail the handshake
+    run_async(main(), timeout=120.0)

@@ -1,0 +1,442 @@
+"""parallel.health: fleet health analytics.
+
+Four concerns, locked separately:
+
+- the judged law itself: gray flags need a robust fleet baseline
+  (median/MAD of the log-latency score), ENTER/EXIT hysteresis, and
+  must never flag the reserved unattributed row;
+- SLO burn-rate tracking: error and latency budgets burn on fast and
+  slow EWMA windows with page/ticket alert thresholds;
+- the sharded forms are BIT-EXACT: plain jitted step, GSPMD-sharded
+  step and hand-collective shard_map step agree on every verdict
+  column over a 100k-row soak (conftest forces 8 virtual CPU
+  devices, so the real all-reduce paths run);
+- the host edge: BackendTable accumulation/drain semantics, the
+  telemetry fold helper, the HealthMonitor tick pipeline, gauge
+  publication, and the end-to-end claim -> trace -> verdict path.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import cueball_tpu as cb
+from cueball_tpu import metrics as mod_metrics
+from cueball_tpu import trace as mod_trace
+from cueball_tpu.parallel import health as H
+from cueball_tpu.parallel.telemetry import fold_backend_slots
+
+from conftest import run_async
+
+
+# -- law helpers ------------------------------------------------------------
+
+N = 8
+
+
+def tick_inputs(ms_by_row: dict, count: int = 10, errors: dict = None,
+                claim_over: dict = None, now_ms: float = 1000.0,
+                reset_rows=(), n=N):
+    """One tick where row r served `count` claims at ms_by_row[r] ms
+    mean service latency (rows absent stay idle but eligible)."""
+    lat_sum = np.zeros(n, np.float32)
+    lat_count = np.zeros(n, np.int32)
+    lat_buckets = np.zeros((n, H.LAT_BINS), np.int32)
+    claim_buckets = np.zeros((n, H.LAT_BINS), np.int32)
+    err = np.zeros(n, np.int32)
+    active = np.zeros(n, bool)
+    eligible = np.zeros(n, bool)
+    reset = np.zeros(n, bool)
+    for r in range(1, n):
+        active[r] = eligible[r] = True
+    for r, ms in ms_by_row.items():
+        lat_sum[r] = ms * count
+        lat_count[r] = count
+        lat_buckets[r, H.latency_bucket(ms)] += count
+        claim_buckets[r, H.latency_bucket(ms)] += count
+    for r, e in (errors or {}).items():
+        err[r] = e
+    for r, cnt in (claim_over or {}).items():
+        claim_buckets[r, H.LAT_BINS - 4] += cnt
+    for r in reset_rows:
+        reset[r] = True
+    return H.health_inputs(
+        n, lat_sum=lat_sum, lat_count=lat_count,
+        lat_buckets=lat_buckets, claim_buckets=claim_buckets,
+        errors=err, active=active, eligible=eligible, reset=reset,
+        now_ms=np.float32(now_ms))
+
+
+HEALTHY = {r: 2.0 for r in range(1, N)}
+
+
+def test_healthy_fleet_flags_nothing():
+    state = H.health_init(N)
+    for _ in range(4):
+        state, verdicts, fleet = H.health_step(
+            state, tick_inputs(HEALTHY))
+    assert not np.asarray(verdicts['gray']).any()
+    assert int(fleet['n_gray']) == 0
+    assert int(fleet['n_backends']) == N - 1
+    assert float(fleet['burn_fast']) == 0.0
+    assert not bool(fleet['alert_page'])
+    assert int(np.asarray(verdicts['epoch'])) == 4
+
+
+def test_gray_enters_after_streak_and_exits_after_clean_streak():
+    slow = dict(HEALTHY)
+    slow[7] = 400.0
+    state = H.health_init(N)
+    # Warm: two healthy ticks seed every EWMA.
+    for _ in range(2):
+        state, verdicts, _ = H.health_step(state, tick_inputs(HEALTHY))
+
+    entered_at = None
+    for i in range(1, H.ENTER_STREAK + 2):
+        state, verdicts, _ = H.health_step(state, tick_inputs(slow))
+        if bool(np.asarray(verdicts['gray'])[7]) and entered_at is None:
+            entered_at = i
+    # Hysteresis: not on the first deviant tick, exactly at the
+    # ENTER_STREAK'th.
+    assert entered_at == H.ENTER_STREAK
+    assert np.asarray(verdicts['gray']).sum() == 1
+
+    # Recovery: the EWMA must decay back under the score floor, then
+    # EXIT_STREAK clean ticks clear the flag — never sooner.
+    gray_ticks = 0
+    for i in range(60):
+        state, verdicts, _ = H.health_step(state, tick_inputs(HEALTHY))
+        if bool(np.asarray(verdicts['gray'])[7]):
+            gray_ticks += 1
+        else:
+            break
+    assert gray_ticks >= H.EXIT_STREAK
+    assert not bool(np.asarray(verdicts['gray'])[7])
+
+
+def test_unattributed_row_never_flags_gray():
+    state = H.health_init(N)
+    for _ in range(6):
+        inp = tick_inputs(HEALTHY)
+        # Hammer row 0 (the reserved unattributed bucket) with awful
+        # latency; eligible[0] is always False.
+        inp = inp._replace(
+            lat_sum=inp.lat_sum.at[0].set(5000.0),
+            lat_count=inp.lat_count.at[0].set(10),
+            active=inp.active.at[0].set(True))
+        state, verdicts, fleet = H.health_step(state, inp)
+    assert not bool(np.asarray(verdicts['gray'])[0])
+    # ...but its traffic still feeds the fleet SLO columns.
+    assert int(fleet['ops']) > (N - 1) * 10
+
+
+def test_small_baseline_never_flags():
+    """With fewer than MIN_BASELINE considered backends there is no
+    robust fleet median to deviate from — nothing may flag."""
+    state = H.health_init(N)
+    two = {1: 2.0, 2: 900.0}
+    for _ in range(6):
+        inp = tick_inputs(two)
+        elig = np.zeros(N, bool)
+        elig[1] = elig[2] = True
+        act = elig.copy()
+        state, verdicts, _ = H.health_step(
+            state, inp._replace(eligible=jnp.asarray(elig),
+                                active=jnp.asarray(act)))
+    assert not np.asarray(verdicts['gray']).any()
+
+
+def test_slo_error_burn_pages_and_tickets():
+    state = H.health_init(N)
+    # 10% failures against a 99.9% success objective: 100x budget.
+    # The fast window (alpha 0.5) pages on the first tick; the slow
+    # window (alpha 0.05) is still under its threshold — that lag IS
+    # the multiwindow design — and files a ticket only as the burn
+    # sustains.
+    bad = tick_inputs(HEALTHY, count=9,
+                      errors={r: 1 for r in range(1, N)})
+    state, _, fleet = H.health_step(state, bad)
+    assert float(fleet['err_rate']) == pytest.approx(0.1)
+    assert float(fleet['burn_fast']) > H.FAST_BURN_ALERT
+    assert bool(fleet['alert_page'])
+    assert not bool(fleet['alert_ticket'])
+    for _ in range(8):
+        state, _, fleet = H.health_step(state, bad)
+    assert bool(fleet['alert_ticket'])
+
+
+def test_slo_latency_burn_and_p99():
+    state = H.health_init(N)
+    # All claims land far beyond the declared claim_p99_ms bound.
+    state, _, fleet = H.health_step(
+        state, tick_inputs({}, claim_over={r: 25 for r in range(1, N)}))
+    assert float(fleet['over_frac']) == pytest.approx(1.0)
+    assert float(fleet['burn_fast']) > H.FAST_BURN_ALERT
+    assert bool(fleet['alert_page'])
+    assert float(fleet['claim_p99_ms']) > H.DEFAULT_OBJECTIVES.claim_p99_ms
+
+    # And a healthy fleet's p99 reads from the claim histogram: 2ms
+    # claims put p99 inside the 2ms bucket's upper edge.
+    state2 = H.health_init(N)
+    _, _, fleet2 = H.health_step(state2, tick_inputs(HEALTHY))
+    k = H.latency_bucket(2.0)
+    upper = 2.0 ** ((k + 1) / H.BUCKET_SCALE) - 1.0
+    assert float(fleet2['claim_p99_ms']) == pytest.approx(upper)
+
+
+def test_objectives_are_compile_time():
+    tight = H.SLOObjectives(success_target=0.5, claim_p99_ms=250.0)
+    step = H.make_health_step(objectives=tight)
+    state = H.health_init(N)
+    state, _, fleet = step(
+        state, tick_inputs(HEALTHY, count=10,
+                           errors={r: 10 for r in range(1, N)}))
+    # 50% errors exactly meets a 50% budget: burn 1.0, no page.
+    assert float(fleet['burn_fast']) <= 1.0
+    assert not bool(fleet['alert_page'])
+    # Memoized per objectives.
+    assert H.make_health_step(objectives=tight) is step
+    assert H.make_health_step() is not step
+
+
+# -- partition rules --------------------------------------------------------
+
+def test_partition_rules_place_every_column():
+    state_specs, inp_specs, out_specs = H.health_specs(('pools',))
+    assert state_specs.lat_hist == P(('pools',), None)
+    assert inp_specs.lat_buckets == P(('pools',), None)
+    assert inp_specs.claim_buckets == P(('pools',), None)
+    assert state_specs.ewma_ms == P(('pools',))
+    assert inp_specs.errors == P(('pools',))
+    # Scalars replicate (rank-0 leaves get the all-None spec).
+    assert state_specs.epoch == P()
+    assert state_specs.burn_fast_err == P()
+    assert out_specs[2]['claim_p99_ms'] == P()
+    assert out_specs[1]['gray'] == P(('pools',))
+
+
+# -- the 100k meshed-vs-plain soak ------------------------------------------
+
+SOAK_ROWS = 100_000
+SOAK_STEPS = 3
+
+
+def pools_mesh(n=8):
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    assert len(devs) >= n, 'conftest should have forced 8 CPU devices'
+    return Mesh(np.array(devs[:n]), ('pools',))
+
+
+def soak_inputs(rng, n, step):
+    lat_count = rng.integers(0, 20, n).astype(np.int32)
+    return H.health_inputs(
+        n,
+        lat_sum=(rng.random(n) * 500.0 * lat_count).astype(np.float32),
+        lat_count=lat_count,
+        lat_buckets=rng.integers(
+            0, 3, (n, H.LAT_BINS)).astype(np.int32),
+        claim_buckets=rng.integers(
+            0, 3, (n, H.LAT_BINS)).astype(np.int32),
+        errors=rng.integers(0, 3, n).astype(np.int32),
+        shed=rng.integers(0, 2, n).astype(np.int32),
+        active=rng.random(n) < 0.9,
+        eligible=rng.random(n) < 0.8,
+        reset=rng.random(n) < 0.02,
+        now_ms=np.float32(1000.0 * (step + 1)))
+
+
+def host(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def test_meshed_and_shardmap_match_plain_bit_for_bit_100k():
+    mesh = pools_mesh()
+    meshed = H.make_health_step(mesh)
+    mapped = H.make_shardmap_health_step(mesh)
+
+    plain_state = H.health_init(SOAK_ROWS)
+    mesh_state = H.shard_health_state(H.health_init(SOAK_ROWS), mesh)
+    map_state = H.health_init(SOAK_ROWS)
+
+    rng = np.random.default_rng(1729)
+    for step in range(SOAK_STEPS):
+        inp = soak_inputs(rng, SOAK_ROWS, step)
+
+        plain_state, p_v, p_f = H.health_step(plain_state, inp)
+        # make_health_step donates: hand it its own state lineage.
+        mesh_state, m_v, m_f = meshed(
+            mesh_state, H.shard_health_inputs(inp, mesh))
+        map_state, s_v, s_f = mapped(map_state, inp)
+
+        p_v, m_v, s_v = host(p_v), host(m_v), host(s_v)
+        for key in p_v:
+            np.testing.assert_array_equal(
+                p_v[key], m_v[key], err_msg='meshed verdict %s' % key)
+            np.testing.assert_array_equal(
+                p_v[key], s_v[key], err_msg='shardmap verdict %s' % key)
+        # Every fleet figure — the f32 scalars included — comes from
+        # replicated int sums, so all three forms agree bit for bit.
+        for fl, form in ((host(m_f), 'meshed'), (host(s_f), 'shardmap')):
+            for key in host(p_f):
+                np.testing.assert_array_equal(
+                    host(p_f)[key], fl[key],
+                    err_msg='%s fleet %s' % (form, key))
+        for st in (mesh_state, map_state):
+            np.testing.assert_array_equal(
+                np.asarray(plain_state.ewma_ms), np.asarray(st.ewma_ms))
+            np.testing.assert_array_equal(
+                np.asarray(plain_state.gray), np.asarray(st.gray))
+
+    # The soak actually judged something on both sides of the law.
+    assert int(np.asarray(plain_state.epoch)) == SOAK_STEPS
+    assert np.asarray(plain_state.ewma_ms).max() > 0.0
+
+
+# -- host edge: table, fold, monitor ----------------------------------------
+
+def test_backend_table_accumulates_and_drains():
+    tbl = H.BackendTable()
+    tbl.observe('be-a', 10.0, 12.0, True)
+    tbl.observe('be-a', 30.0, 31.0, True)
+    tbl.observe('be-b', None, 50.0, False)
+    tbl.observe_shed('be-b')
+    tbl.observe('', 1.0, 1.0, True)      # unattributed bucket
+    ra = mod_trace.backend_index('be-a')
+    rb = mod_trace.backend_index('be-b')
+    cols = tbl.drain()
+    assert cols['lat_sum'][ra] == pytest.approx(40.0)
+    assert cols['lat_count'][ra] == 2
+    assert cols['errors'][rb] == 1
+    assert cols['shed'][rb] == 1
+    assert cols['lat_count'][0] == 1
+    assert cols['active'][0] and not cols['eligible'][0]
+    assert cols['eligible'][ra] and cols['eligible'][rb]
+    # First drain marks fresh rows for state reset; the next does not.
+    assert cols['reset'][ra] and cols['reset'][rb]
+    cols2 = tbl.drain()
+    assert cols2['lat_sum'][ra] == 0.0          # drained atomically
+    assert not cols2['reset'][ra]
+    assert cols2['eligible'][ra]                # seen stays sticky
+
+
+def test_fold_backend_slots_pads_to_step_shape():
+    tbl = H.BackendTable(capacity=3)
+    tbl.observe('be-fold', 5.0, 6.0, True)
+    cols = tbl.drain()
+    # The drain is as wide as the process-global backend registry
+    # ('be-fold' lands wherever prior tests left the next free row),
+    # so the step shape to pad to is derived, not hard-coded.
+    rows = len(cols['active']) + 16
+    folded = fold_backend_slots(cols, rows)
+    for name, col in folded.items():
+        assert col.shape[0] == rows, name
+    assert folded['lat_buckets'].shape == (rows, H.LAT_BINS)
+    assert not folded['active'][len(cols['active']):].any()
+
+
+def test_monitor_ticks_grows_and_publishes_gauges():
+    collector = mod_metrics.create_collector()
+    mon = H.HealthMonitor({'collector': collector, 'shard': 3}).start()
+    try:
+        assert mon in H.active_monitors()
+        for _ in range(40):
+            mon.hm_table.observe('be-mon-a', 2.0, 3.0, True)
+            mon.hm_table.observe('be-mon-b', 2.0, 3.0, True)
+        rec = mon.tick(now_ms=1000.0)
+        assert rec['epoch'] == 1
+        assert rec['backends']['be-mon-a']['ewma_ms'] == \
+            pytest.approx(2.0)
+        rows_before = mon.hm_rows
+
+        # Force table growth past the padded state: the carried state
+        # pads forward instead of restarting.
+        for i in range(rows_before + 4):
+            mon.hm_table.observe('be-mon-grow-%d' % i, 2.0, 3.0, True)
+        rec = mon.tick(now_ms=2000.0)
+        assert mon.hm_rows > rows_before
+        assert rec['epoch'] == 2
+        assert rec['backends']['be-mon-a']['ewma_ms'] > 0.0  # survived
+
+        text = collector.collect()
+        assert 'cueball_backend_health{backend="be-mon-a",shard="3"}' \
+            in text
+        assert 'cueball_backend_latency_ewma_ms' in text
+        assert 'objective="success",shard="3",window="fast"' in text
+        assert 'window="slow"' in text
+
+        snap = mon.snapshot()
+        assert snap['objectives']['success_target'] == \
+            H.DEFAULT_OBJECTIVES.success_target
+        assert snap['last']['epoch'] == 2
+        assert len(snap['history']) == 2
+    finally:
+        mon.stop()
+    assert mon not in H.active_monitors()
+
+
+def test_reduce_health_merges_shard_verdicts():
+    a = {'epoch': 3, 'at_ms': 1.0, 'gray': ['be-x'],
+         'backends': {},
+         'fleet': {'n_backends': 4, 'n_gray': 1, 'ops': 100,
+                   'errors': 10, 'shed': 1, 'err_rate': 0.1,
+                   'claim_p99_ms': 40.0, 'burn_fast': 2.0,
+                   'burn_slow': 1.0, 'alert_page': False,
+                   'alert_ticket': True}}
+    b = {'epoch': 5, 'at_ms': 2.0, 'gray': ['be-y'],
+         'backends': {},
+         'fleet': {'n_backends': 2, 'n_gray': 1, 'ops': 300,
+                   'errors': 0, 'shed': 0, 'err_rate': 0.0,
+                   'claim_p99_ms': 90.0, 'burn_fast': 20.0,
+                   'burn_slow': 0.5, 'alert_page': True,
+                   'alert_ticket': False}}
+    fleet = H.reduce_health([a, None, b])
+    assert fleet['gray'] == ['be-x', 'be-y']
+    assert fleet['n_backends'] == 6 and fleet['ops'] == 400
+    # ops-weighted error rate; worst-shard burns and p99; alert OR.
+    assert fleet['err_rate'] == pytest.approx(10 / 400)
+    assert fleet['claim_p99_ms'] == 90.0
+    assert fleet['burn_fast'] == 20.0 and fleet['burn_slow'] == 1.0
+    assert fleet['alert_page'] and fleet['alert_ticket']
+    empty = H.reduce_health([])
+    assert empty['n_backends'] == 0 and empty['gray'] == []
+    assert not empty['alert_page']
+
+
+def test_claim_to_verdict_end_to_end():
+    """A real pool claim attributes through the trace layer into the
+    monitor: the verdict record names the pool's backend key."""
+    import asyncio
+
+    from test_debug import build_pool, settle
+
+    async def t():
+        mod_trace.enable_tracing(ring_size=64, sample_rate=1.0)
+        mon = H.HealthMonitor().start()
+        try:
+            pool, res = build_pool()
+            await settle(pool)
+            fut = asyncio.get_running_loop().create_future()
+
+            def cb(err, hdl=None, conn=None):
+                fut.set_result((err, hdl))
+            pool.claim_cb({'timeout': 1000}, cb)
+            err, hdl = await fut
+            assert err is None
+            # Hold the lease for a beat so the service span has a
+            # strictly positive duration (a 0ms EWMA never publishes).
+            await asyncio.sleep(0.005)
+            hdl.release()
+            await asyncio.sleep(0.02)
+            rec = mon.tick()
+            key = pool.p_keys[0]
+            assert key in rec['backends'], sorted(rec['backends'])
+            assert int(rec['fleet']['ops']) >= 1
+            pool.stop()
+        finally:
+            mon.stop()
+            mod_trace.disable_tracing()
+    run_async(t())

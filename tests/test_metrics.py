@@ -1,0 +1,127 @@
+"""Exposition-format merging (metrics.merge_expositions) and the
+label-value escaping round-trip.
+
+The spawn shard backend scrapes one collector per child and merges the
+texts into a single fleet payload; repeating a ``# HELP``/``# TYPE``
+header pair mid-payload is a text-format spec violation that breaks
+strict scrapers, so the merge must group every family's samples under
+exactly one header pair regardless of how many children declared it."""
+
+import re
+
+from cueball_tpu import metrics as mod_metrics
+from cueball_tpu.metrics import (Collector, _escape_label_value,
+                                 _unescape_label_value,
+                                 merge_expositions)
+
+
+def _shard_text(shard: int, value: float) -> str:
+    c = Collector()
+    c.gauge('cueball_fleet_mean_load', 'mean fleet load').set(
+        value, {'shard': str(shard)})
+    c.counter('cueball_claims', 'claims served').increment(
+        {'shard': str(shard)}, 3 + shard)
+    return c.collect()
+
+
+class TestMergeExpositions:
+
+    def test_headers_appear_exactly_once_per_family(self):
+        merged = merge_expositions(
+            [_shard_text(0, 0.25), _shard_text(1, 0.75),
+             _shard_text(2, 0.5)])
+        for name in ('cueball_fleet_mean_load', 'cueball_claims'):
+            assert merged.count('# HELP %s' % name) == 1
+            assert merged.count('# TYPE %s' % name) == 1
+        # Every child's sample rows survive, shard-disambiguated.
+        for shard in range(3):
+            assert 'shard="%d"' % shard in merged
+
+    def test_samples_group_under_their_family_header(self):
+        merged = merge_expositions([_shard_text(0, 1.0),
+                                    _shard_text(1, 2.0)])
+        lines = merged.splitlines()
+        current = None
+        for line in lines:
+            m = re.match(r'# (?:HELP|TYPE) (\S+)', line)
+            if m:
+                current = m.group(1)
+                continue
+            name = line.split('{', 1)[0]
+            assert name == current, \
+                'sample %r under %r header' % (line, current)
+
+    def test_merge_is_idempotent(self):
+        texts = [_shard_text(0, 1.0), _shard_text(1, 2.0)]
+        once = merge_expositions(texts)
+        assert merge_expositions([once]) == once
+
+    def test_histogram_rows_stay_with_their_family(self):
+        c = Collector()
+        c.histogram('cueball_claim_ms', 'claim latency').observe(
+            12.0, {'shard': '0'})
+        c.gauge('cueball_up', 'liveness').set(1.0)
+        merged = merge_expositions([c.collect(), c.collect()])
+        assert merged.count('# TYPE cueball_claim_ms histogram') == 1
+        # _bucket/_sum/_count rows double (two scrapes) but never pull
+        # in a second header.
+        assert merged.count('cueball_claim_ms_bucket{') == \
+            2 * (len(mod_metrics.DEFAULT_BUCKETS) + 1)
+
+    def test_first_declaration_wins_help_text(self):
+        a = '# HELP m from_a\n# TYPE m gauge\nm 1\n'
+        b = '# HELP m from_b\n# TYPE m gauge\nm 2\n'
+        merged = merge_expositions([a, b])
+        assert '# HELP m from_a' in merged
+        assert 'from_b' not in merged
+        assert merged.count('# TYPE m gauge') == 1
+
+    def test_empty_help_has_no_trailing_space(self):
+        c = Collector()
+        c.gauge('m').set(1.0)
+        merged = merge_expositions([c.collect()])
+        assert '# HELP m\n' in merged
+
+    def test_plain_comments_do_not_become_families(self):
+        text = ('# scraped by shard 0\n'
+                '# HELP m help\n# TYPE m gauge\nm 1\n# EOF\n')
+        merged = merge_expositions([text, text])
+        assert '# scraped' not in merged
+        assert '# EOF' not in merged
+        assert merged.count('# HELP m help') == 1
+        assert merged.count('m 1') == 2
+
+    def test_empty_and_none_payloads(self):
+        assert merge_expositions([]) == ''
+        assert merge_expositions(['', _shard_text(0, 1.0)]) == \
+            merge_expositions([_shard_text(0, 1.0)])
+
+
+class TestLabelEscapingRoundTrip:
+
+    HOSTILE = ['plain', 'sla"shed', 'back\\slash', 'new\nline',
+               'all\\of"it\ntogether', '\\', '\\n', 'trailing\\']
+
+    def test_escape_unescape_round_trip(self):
+        for value in self.HOSTILE:
+            esc = _escape_label_value(value)
+            assert '\n' not in esc
+            assert _unescape_label_value(esc) == value
+
+    def test_collect_merge_parse_round_trip(self):
+        """A hostile label value survives collect() -> merge -> parse:
+        the payload stays line-oriented and the parsed value matches
+        the original byte for byte."""
+        for value in self.HOSTILE:
+            c = Collector()
+            c.gauge('cueball_backend_health', 'verdict').set(
+                1.0, {'backend': value})
+            merged = merge_expositions([c.collect(), c.collect()])
+            assert merged.count('# TYPE cueball_backend_health') == 1
+            rows = [ln for ln in merged.splitlines()
+                    if ln.startswith('cueball_backend_health{')]
+            assert rows
+            m = re.match(
+                r'cueball_backend_health\{backend="(.*)"\} 1$', rows[0])
+            assert m, rows[0]
+            assert _unescape_label_value(m.group(1)) == value

@@ -279,6 +279,7 @@ def build_html(outdir: str, paths: list[str]) -> int:
 #: public top-level name they define themselves.
 API_MODULES = ('cueball_tpu', 'cueball_tpu.parallel',
                'cueball_tpu.parallel.control',
+               'cueball_tpu.parallel.health',
                'cueball_tpu.ops', 'cueball_tpu.netsim',
                'cueball_tpu.shard',
                'cueball_tpu.integrations.httpx',
