@@ -5,8 +5,8 @@ LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
 .PHONY: test check bench bench-host bench-sharded bench-control \
-	bench-health dryrun coverage native ci docs docs-check fsm-graph \
-	scenarios scenarios-fast
+	bench-health bench-profile profile dryrun coverage native ci docs \
+	docs-check fsm-graph scenarios scenarios-fast
 
 native:
 	$(PYTHON) native/build.py
@@ -49,6 +49,7 @@ ci: native check docs-check
 	$(PYTHON) tools/cbfsm.py --check-graphs docs/fsm cueball_tpu
 	$(PYTHON) -m pytest tests/ -x -q -m 'not slow'
 	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -x -q -m 'not slow'
+	$(PYTHON) tools/cbprofile.py --smoke
 	$(MAKE) dryrun
 
 bench:
@@ -75,6 +76,27 @@ bench-control:
 # arm). One JSON line.
 bench-health:
 	$(PYTHON) bench.py --health-only
+
+# Claim-path profiler stages alone (docs/claim-path-profile.md): the
+# phase-ledger cost-attribution table (fast/queued path x pump
+# on/off), the SIGPROF sampler overhead A/B, and the native-vs-pure
+# flamegraph identity receipt. One JSON line.
+bench-profile:
+	$(PYTHON) bench.py --profile-only
+
+# Attach the claim-path profiler to a RUNNING kang process:
+#   make profile PID=<pid> PORT=<kang port> [SECONDS=2]
+# sends SIGUSR2 (arming the SIGPROF sampler), scrapes /kang/profile,
+# prints the collapsed-stack flamegraph, and disarms. Without PID/PORT
+# it runs the self-contained smoke (spawn a throwaway claim workload,
+# attach to it, check the flamegraph) — the form `make ci` runs.
+SECONDS_ARG = $(if $(SECONDS),--seconds=$(SECONDS),)
+profile:
+ifeq ($(PID),)
+	$(PYTHON) tools/cbprofile.py --smoke
+else
+	$(PYTHON) tools/cbprofile.py $(PID) $(PORT) $(SECONDS_ARG)
+endif
 
 # The shard-router scaling sweep only (docs/sharding.md): K=1,2,4,8
 # spawn-backend shards, aggregate claim throughput per K, and the

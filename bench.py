@@ -921,6 +921,294 @@ async def bench_attribution_ab(ops=TRACING_AB_OPS_PER_TRIAL,
     return out
 
 
+# Claim-path profiler stages (ISSUE 13): the cost-attribution table is
+# built from the phase ledger over PROFILE_TABLE_OPS traced claims per
+# cell (fast vs queued path, pump on vs off), and the A/B measures the
+# SIGPROF sampler's increment over the already-budgeted tracing cost.
+PROFILE_TABLE_OPS = 2000
+PROFILE_TABLE_RING = 2048
+
+
+async def bench_profile_ab(ops=TRACING_AB_OPS_PER_TRIAL,
+                           trials=TRACING_AB_TRIALS):
+    """Profiler-off vs -on claim-path A/B (ISSUE 13 acceptance: the
+    SIGPROF sampler must cost <= 1% on the claim hot path).
+
+    Same interleaved three-arm protocol as the tracing/attribution
+    A/Bs, every arm traced at full rate: the quantity under test is
+    what the armed sampler adds on top of tracing — the ITIMER_PROF
+    signal deliveries plus the phase-seam loads — not tracing
+    itself."""
+    import gc
+    import statistics
+    from cueball_tpu import profile as mod_profile
+    from cueball_tpu import trace as mod_trace
+    build_pool = make_fixture()
+    pool = build_pool()
+    await settle(pool)
+    mod_trace.enable_tracing(ring_size=256, sample_rate=1.0)
+
+    async def run_arm(profiler):
+        armed = False
+        if profiler:
+            armed = mod_profile.start_sampler()
+        try:
+            gc.disable()
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                hdl, conn = await pool.claim({'timeout': 1000})
+                hdl.release()
+            elapsed = time.perf_counter() - t0
+            gc.enable()
+        finally:
+            if armed:
+                mod_profile.stop_sampler()
+        return ops / elapsed
+
+    arms = {'off_pre': [], 'on': [], 'off_post': []}
+    warmup = True
+    frozen = False
+    speed_redos = 0
+    sampler_armed = True
+    try:
+        while len(arms['on']) < trials:
+            if not warmup and not frozen:
+                gc.collect()
+                gc.freeze()
+                frozen = True
+            gc.collect()
+            await speed_gate()
+            rates = {}
+            for arm in arms:
+                rates[arm] = await run_arm(arm == 'on')
+            sampler_armed = sampler_armed and \
+                mod_profile.sampler_stats()['samples'] > 0
+            clean = _speed_ok(_speed_probe())
+            if warmup:
+                warmup = False
+                continue
+            if not clean and speed_redos < trials:
+                speed_redos += 1
+                continue
+            for arm, rate in rates.items():
+                arms[arm].append(rate)
+    finally:
+        mod_trace.disable_tracing()
+        mod_profile.reset_samples()
+    pool.stop()
+    while not pool.is_in_state('stopped'):
+        await asyncio.sleep(0.01)
+
+    out = {}
+    for arm, xs in arms.items():
+        out[arm + '_ops_per_sec'] = round(statistics.mean(xs), 1)
+        out[arm + '_stdev'] = round(
+            statistics.stdev(xs) if len(xs) > 1 else 0.0, 1)
+        out[arm + '_trials'] = [round(r, 1) for r in xs]
+    per_round = []
+    for i in range(len(arms['on'])):
+        off_i = (arms['off_pre'][i] + arms['off_post'][i]) / 2.0
+        per_round.append(100.0 * (off_i - arms['on'][i]) / off_i)
+    out['profiler_on_overhead_pct_rounds'] = [
+        round(x, 2) for x in per_round]
+    out['profiler_on_overhead_pct'] = round(
+        statistics.median(per_round), 2)
+    out['sampler_collected_samples'] = bool(sampler_armed)
+    out['speed_gate_redone_rounds'] = speed_redos
+    out['protocol'] = ('%d rounds x %d ops x 3 interleaved arms '
+                       '(off-pre / on / off-post) back to back against '
+                       'one settled pool, tracing enabled at full rate '
+                       'in ALL arms; on = the SIGPROF phase sampler '
+                       'armed; 1 warmup round, gc frozen+disabled in '
+                       'timed sections, speed-gated with degraded '
+                       'rounds redone; overhead pct is the median of '
+                       'per-round paired deltas') % (trials, ops)
+    return out
+
+
+async def _profile_table_cell(queued, pump, ops=PROFILE_TABLE_OPS):
+    """One cost-attribution cell: run `ops` fully-traced claims on the
+    chosen path with the pump on/off, then fold the trace ring through
+    the phase ledger. Returns the ledger summary + the cell's rate."""
+    from cueball_tpu import profile as mod_profile
+    from cueball_tpu import runq
+    from cueball_tpu import trace as mod_trace
+    import gc
+    build_pool = make_fixture()
+    pool = build_pool()
+    await settle(pool)
+    prev_pump = runq.set_pump_enabled(pump)
+    mod_trace.enable_tracing(ring_size=PROFILE_TABLE_RING,
+                             sample_rate=1.0)
+    try:
+        gc.collect()
+        await speed_gate()
+        gc.disable()
+        if queued:
+            done = asyncio.Event()
+            count = [0]
+
+            def make_claim():
+                def cb(err, hdl=None, conn=None):
+                    assert err is None, err
+                    count[0] += 1
+                    hdl.release()
+                    if count[0] >= ops:
+                        if not done.is_set():
+                            done.set()
+                        return
+                    make_claim()
+                pool.claim_cb({}, cb)
+
+            t0 = time.perf_counter()
+            for _ in range(QUEUED_OUTSTANDING):
+                make_claim()
+            await done.wait()
+            elapsed = time.perf_counter() - t0
+        else:
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                hdl, conn = await pool.claim({'timeout': 1000})
+                hdl.release()
+            elapsed = time.perf_counter() - t0
+        gc.enable()
+        # Let the last releases' deferred trace events drain before
+        # the ledger reads the ring.
+        await asyncio.sleep(0.05)
+        summary = mod_profile.ledger_summary(mod_profile.phase_ledger())
+    finally:
+        mod_trace.disable_tracing()
+        runq.set_pump_enabled(prev_pump)
+    pool.stop()
+    while not pool.is_in_state('stopped'):
+        await asyncio.sleep(0.01)
+    cell = {
+        'path': 'queued' if queued else 'fast',
+        'pump': 'on' if pump else 'off',
+        'ops_per_sec': round(ops / elapsed, 1),
+        'claims': summary['claims'],
+        'wall_ms': round(summary['wall_ms'], 3),
+        'phase_ms': {p: round(ms, 3)
+                     for p, ms in summary['phase_ms'].items()},
+        'coverage': round(summary['coverage'], 4),
+    }
+    return cell
+
+
+async def bench_profile_attribution():
+    """The committed cost-attribution table (ISSUE 13 tentpole): where
+    a claim's wall time goes, phase by phase, on the fast path (claim
+    hits an idle slot) and the queued path (32 claims outstanding over
+    2 slots), with the runq pump on and off. Each cell is the phase
+    ledger folded over PROFILE_TABLE_OPS fully-traced claims; the
+    acceptance gate holds coverage (the named share of wall time) at
+    >= 0.95 on both paths."""
+    cells = {}
+    for queued in (False, True):
+        for pump in (True, False):
+            cell = await _profile_table_cell(queued, pump)
+            cells['%s_pump_%s' % (cell['path'], cell['pump'])] = cell
+    return {
+        'cells': cells,
+        'ops_per_cell': PROFILE_TABLE_OPS,
+        'fast_coverage': min(
+            cells['fast_pump_on']['coverage'],
+            cells['fast_pump_off']['coverage']),
+        'queued_coverage': min(
+            cells['queued_pump_on']['coverage'],
+            cells['queued_pump_off']['coverage']),
+    }
+
+
+def _profile_flamegraph_run(native, seed=1234, claims=8):
+    """One deterministic virtual-time pool run with full-rate tracing
+    under the chosen recorder; returns the /kang/profile flamegraph
+    text computed from the resulting ring (the sampler auto-disables
+    under the netsim VirtualClock, so the text is pure ledger
+    arithmetic)."""
+    from cueball_tpu import netsim
+    from cueball_tpu import profile as mod_profile
+    from cueball_tpu import trace as mod_trace
+    from cueball_tpu.pool import ConnectionPool
+    from cueball_tpu.resolver import StaticIpResolver
+
+    fabric = netsim.Fabric()
+
+    async def run():
+        mod_trace.enable_tracing(ring_size=64, sample_rate=1.0,
+                                 native=native)
+        res = StaticIpResolver({'backends': [
+            {'address': '10.0.0.1', 'port': 80},
+            {'address': '10.0.0.2', 'port': 80}]})
+        pool = ConnectionPool({
+            'domain': 'svc.sim',
+            'constructor': fabric.constructor,
+            'resolver': res,
+            'spares': 2,
+            'maximum': 4,
+            'recovery': {'default': {'retries': 2, 'timeout': 500,
+                                     'delay': 100, 'maxDelay': 400}},
+        })
+        res.start()
+        while not pool.is_in_state('running'):
+            await asyncio.sleep(0.05)
+        sampler_refused = not mod_profile.start_sampler()
+        for i in range(claims):
+            hdl, conn = await pool.claim({'timeout': 1000.0})
+            await asyncio.sleep(0.005 * (i % 4 + 1))
+            hdl.release()
+        await asyncio.sleep(0.1)
+        text = mod_profile.flamegraph()
+        pool.stop()
+        while not pool.is_in_state('stopped'):
+            await asyncio.sleep(0.05)
+        res.stop()
+        mod_trace.disable_tracing()
+        return text, sampler_refused
+
+    return netsim.run(run(), seed=seed)
+
+
+def bench_profile_flamegraph_identity():
+    """Acceptance receipt: on a seeded netsim scenario the
+    /kang/profile flamegraph is byte-identical between the native and
+    pure trace recorders (the ledger is replay arithmetic, and the
+    sampler refuses to arm under the VirtualClock)."""
+    import threading
+    from cueball_tpu import trace as mod_trace
+    if not mod_trace._NATIVE_TRACE_OK:
+        return {'skipped': 'C engine not loaded'}
+
+    def in_thread(native):
+        # netsim.run spins its own VirtualLoop, which cannot nest
+        # inside the bench's running loop; a worker thread gives it a
+        # loop-free context. The bench loop is blocked on join() the
+        # whole time, so the process-wide clock/RNG seam swap the run
+        # performs never races it.
+        out = {}
+
+        def target():
+            try:
+                out['value'] = _profile_flamegraph_run(native=native)
+            except BaseException as exc:  # surfaced on join below
+                out['error'] = exc
+
+        t = threading.Thread(target=target, name='bench-flamegraph')
+        t.start()
+        t.join()
+        if 'error' in out:
+            raise out['error']
+        return out['value']
+
+    a, refused_a = in_thread(native=True)
+    b, refused_b = in_thread(native=False)
+    return {
+        'identical': a == b,
+        'lines': len(a.splitlines()),
+        'sampler_auto_disabled': bool(refused_a and refused_b),
+    }
+
+
 async def bench_pump_ab(ops=CLAIM_OPS_PER_TRIAL, trials=CLAIM_TRIALS):
     """Pump-off vs pump-on claim-path A/B (the tentpole's receipt).
 
@@ -1744,7 +2032,9 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
                     tracing_ab=None, pump_ab=None,
                     probe=None, sharded=None, sweeps=None,
                     actuation_ab=None, attribution_ab=None,
-                    health=None) -> dict:
+                    health=None, profile_ab=None,
+                    profile_attribution=None,
+                    profile_flamegraph=None) -> dict:
     """Build the single JSON-line result from the stage outputs.
 
     Factored out of main() so the guard tests can assert the
@@ -1872,6 +2162,12 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
         result['claim_tracing_ab'] = tracing_ab
     if pump_ab is not None:
         result['claim_pump_ab'] = pump_ab
+    if profile_ab is not None:
+        result['claim_profile_ab'] = profile_ab
+    if profile_attribution is not None:
+        result['profile_attribution'] = profile_attribution
+    if profile_flamegraph is not None:
+        result['profile_flamegraph'] = profile_flamegraph
     if sharded is not None:
         result['claim_sharded'] = sharded
         arms = sharded.get('arms') or {}
@@ -1901,7 +2197,8 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
 
 
 async def main(host_only: bool = False, sharded_only: bool = False,
-               control_only: bool = False, health_only: bool = False):
+               control_only: bool = False, health_only: bool = False,
+               profile_only: bool = False):
     """Run the bench and print ONE JSON line.
 
     host_only=True (the `make bench-host` / --host-only path) runs
@@ -1962,6 +2259,22 @@ async def main(host_only: bool = False, sharded_only: bool = False,
         }))
         return
 
+    if profile_only:
+        # `make bench-profile`: the claim-path profiler stages alone —
+        # the cost-attribution table (fast/queued x pump on/off), the
+        # sampler-overhead A/B, and the native-vs-pure flamegraph
+        # identity receipt. One JSON line.
+        profile_attribution = await bench_profile_attribution()
+        profile_ab = await bench_profile_ab()
+        print(json.dumps({
+            'profile_only': True,
+            'profile_attribution': profile_attribution,
+            'claim_profile_ab': profile_ab,
+            'profile_flamegraph': bench_profile_flamegraph_identity(),
+            'telemetry_code_hash': telemetry_code_hash(),
+        }))
+        return
+
     if health_only:
         # `make bench-health`: the fleet-health stages alone.
         sweeps = bench_health_sweeps_host()
@@ -1992,6 +2305,9 @@ async def main(host_only: bool = False, sharded_only: bool = False,
     pump_ab = await bench_pump_ab()
     actuation_ab = await bench_actuation_ab()
     attribution_ab = await bench_attribution_ab()
+    profile_ab = await bench_profile_ab()
+    profile_attribution = await bench_profile_attribution()
+    profile_flamegraph = bench_profile_flamegraph_identity()
     host_tick = bench_sampler_tick_host()
     telem = {} if host_only else bench_telemetry_step_guarded(
         probe=probe)
@@ -2009,7 +2325,9 @@ async def main(host_only: bool = False, sharded_only: bool = False,
                              probe=probe, sharded=sharded,
                              sweeps=sweeps, actuation_ab=actuation_ab,
                              attribution_ab=attribution_ab,
-                             health=health)
+                             health=health, profile_ab=profile_ab,
+                             profile_attribution=profile_attribution,
+                             profile_flamegraph=profile_flamegraph)
     if host_only:
         result['host_only'] = True
     print(json.dumps(result))
@@ -2020,4 +2338,5 @@ if __name__ == '__main__':
     asyncio.run(main(host_only='--host-only' in sys.argv[1:],
                      sharded_only='--sharded-only' in sys.argv[1:],
                      control_only='--control-only' in sys.argv[1:],
-                     health_only='--health-only' in sys.argv[1:]))
+                     health_only='--health-only' in sys.argv[1:],
+                     profile_only='--profile-only' in sys.argv[1:]))

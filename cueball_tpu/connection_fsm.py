@@ -40,6 +40,11 @@ from .events import _native
 from .fsm import FSM
 from .runq import defer
 
+# Bound to cueball_tpu.profile while its sampler runs, so SIGPROF
+# samples landing inside connection-open plumbing attribute to the
+# socket_wait phase.
+_prof = None
+
 # Terminal claim handles are recycled through a C freelist when the
 # native engine is loaded (see obtain_claim_handle): allocating the
 # handle + its dict + FSM innards is a measurable slice of the queued
@@ -305,7 +310,15 @@ class SocketMgrFSM(FSM):
         S.timeout(self.sm_timeout, on_timeout)
 
         self.sm_log.debug('calling constructor to open new connection')
-        self.sm_socket = self.sm_constructor(self.sm_backend)
+        prof = _prof
+        if prof is None:
+            self.sm_socket = self.sm_constructor(self.sm_backend)
+        else:
+            tok = prof.push_phase('socket_wait')
+            try:
+                self.sm_socket = self.sm_constructor(self.sm_backend)
+            finally:
+                prof.pop_phase(tok)
         if self.sm_socket is None:
             raise AssertionError('constructor returned no connection')
         self.sm_socket.sm_fsm = self
@@ -958,7 +971,15 @@ class ConnectionSlotFSM(FSM):
                     'Unhandled smgr state transition: .connect() => '
                     '"%s"' % st)
         S.on(smgr, 'stateChanged', on_changed)
-        smgr.connect()
+        prof = _prof
+        if prof is None:
+            smgr.connect()
+        else:
+            tok = prof.push_phase('socket_wait')
+            try:
+                smgr.connect()
+            finally:
+                prof.pop_phase(tok)
 
     def state_failed(self, S):
         S.validTransitions([])

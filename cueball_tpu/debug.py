@@ -168,6 +168,14 @@ def dump_fsm_histories(stream=None) -> str:
     if traces:
         buf.write(traces)
 
+    # Claim-path profiler: sampler state, fleet cost attribution, and
+    # the slowest claims' phase ledgers. '' (section absent, dump still
+    # well-formed) when nothing was ever profiled.
+    from . import profile as mod_profile
+    prof = mod_profile.dump_profile()
+    if prof:
+        buf.write(prof)
+
     report = buf.getvalue()
     if stream is not None:
         stream.write(report)
@@ -195,6 +203,20 @@ def _on_debug_signal(signum, frame) -> None:
         mod_utils.disable_stack_traces()
     else:
         mod_utils.enable_stack_traces()
+    # The toggle doubles as the profiler attach point (tools/cbprofile
+    # `make profile`): first USR2 arms the SIGPROF phase sampler,
+    # second disarms it — the dump that follows each delivery shows
+    # the sampler state and whatever it collected. start/stop are
+    # no-ops-with-reasons (netsim clock, non-main thread), never
+    # raises out of a signal handler.
+    try:
+        from . import profile as mod_profile
+        if mod_utils.stack_traces_enabled():
+            mod_profile.start_sampler()
+        else:
+            mod_profile.stop_sampler()
+    except Exception:
+        pass
     import asyncio
     try:
         loop = asyncio.get_running_loop()

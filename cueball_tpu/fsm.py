@@ -45,6 +45,12 @@ from . import utils as mod_utils
 # transition in the process reports here with negligible cost when empty.
 _TRANSITION_TRACERS: list[typing.Callable] = []
 
+# Bound to cueball_tpu.profile while its sampler runs, so SIGPROF
+# samples landing inside a state-entry function attribute to the fsm
+# phase (the native engine marks the phase in C; this seam covers the
+# pure engine).
+_prof = None
+
 
 def add_transition_tracer(fn: typing.Callable) -> None:
     _TRANSITION_TRACERS.append(fn)
@@ -382,7 +388,15 @@ class FSM(EventEmitter):
         for tracer in _TRANSITION_TRACERS:
             tracer(self, old, state)
 
-        entry(self, new_handle)
+        prof = _prof
+        if prof is None:
+            entry(self, new_handle)
+        else:
+            tok = prof.push_phase('fsm')
+            try:
+                entry(self, new_handle)
+            finally:
+                prof.pop_phase(tok)
 
         # Async (setImmediate-analogue) stateChanged emission; ordering
         # across rapid transitions is preserved by the pump's FIFO.

@@ -23,6 +23,9 @@ from PoolMonitor.to_kang_options().
                                   a span attributed to that backend
     GET /kang/health            - health monitors' verdicts: per-backend
                                   gray flags and SLO burn rates
+    GET /kang/profile           - claim-path profile as collapsed-stack
+                                  flamegraph text (ledger phases +
+                                  sampler hits; empty when idle)
     GET /metrics                - prometheus text metrics (collector)
 """
 
@@ -178,15 +181,35 @@ def _route(method: str, path: str, collector):
                                            keep_blank_values=True)
             limit = backend = None
             if 'limit' in params:
-                limit = int(params['limit'][-1])
+                try:
+                    limit = int(params['limit'][-1])
+                except ValueError:
+                    return (400, ctype, json.dumps(
+                        {'error': 'limit must be an integer, got %r'
+                                  % params['limit'][-1]}).encode())
+                if limit < 0:
+                    return (400, ctype, json.dumps(
+                        {'error': 'limit must be >= 0, got %d'
+                                  % limit}).encode())
             if 'backend' in params:
                 backend = params['backend'][-1]
+                if not mod_trace.backend_known(backend):
+                    return (400, ctype, json.dumps(
+                        {'error': 'unknown backend %r' % backend}
+                    ).encode())
             body = mod_trace.filter_ndjson(
                 mod_trace.export_ndjson(), limit, backend).encode()
             ctype = 'application/x-ndjson'
         elif path == '/kang/health':
             body = json.dumps(_health_payload(),
                               default=_json_default).encode()
+        elif path == '/kang/profile':
+            # Collapsed-stack flamegraph text: one "frame;frame N"
+            # line per ledger phase and sampler bucket; feed to any
+            # flamegraph renderer. Empty when nothing was profiled.
+            from . import profile as mod_profile
+            body = mod_profile.flamegraph().encode()
+            ctype = 'text/plain; charset=utf-8'
         elif path == '/metrics' and collector is not None:
             body = collector.collect().encode()
             ctype = 'text/plain; version=0.0.4'

@@ -264,9 +264,16 @@ def merge_expositions(texts) -> str:
     grouped under one ``# HELP``/``# TYPE`` header pair per metric
     family (repeating a family header mid-payload is a spec
     violation); the first payload to declare a family wins its header.
-    Sample rows are kept verbatim and in arrival order — children are
-    expected to disambiguate with a ``shard`` label, exactly like the
-    thread backend's shard-labelled gauges on a shared collector.
+    Gauge/counter sample rows are kept verbatim and in arrival order —
+    children are expected to disambiguate with a ``shard`` label,
+    exactly like the thread backend's shard-labelled gauges on a shared
+    collector. Histogram families instead FOLD: identical series
+    (same name + label set, including ``le``) sum across payloads, so
+    the merged cumulative buckets, ``_sum`` and ``_count`` describe the
+    fleet-wide distribution — children's per-phase claim histograms
+    carry no shard label on purpose, and verbatim concatenation would
+    emit duplicate series (a spec violation Prometheus resolves by
+    keeping only one child's data).
     """
     families: dict[str, dict] = {}
     order: list[str] = []
@@ -316,5 +323,35 @@ def merge_expositions(texts) -> str:
             out.append(('# HELP %s %s' % (name, fam['help'])).rstrip())
         if fam['type'] is not None:
             out.append('# TYPE %s %s' % (name, fam['type']))
-        out.extend(fam['samples'])
+        if fam['type'] == 'histogram':
+            out.extend(_fold_histogram_samples(fam['samples']))
+        else:
+            out.extend(fam['samples'])
     return '\n'.join(out) + '\n' if out else ''
+
+
+def _fold_histogram_samples(lines) -> list:
+    """Sum same-series histogram rows (identical name + label string,
+    so cumulative ``_bucket`` rows fold per ``le`` and ``_sum`` /
+    ``_count`` fold per label set). Our serializer emits labels in
+    sorted key order, so the label string is a stable series key.
+    First-seen series order is preserved and values re-format with the
+    serializer's %g, which keeps the merge idempotent. Rows whose
+    value doesn't parse pass through verbatim at the end."""
+    totals: dict[str, float] = {}
+    order: list[str] = []
+    passthrough: list[str] = []
+    for line in lines:
+        series, _, value = line.rpartition(' ')
+        try:
+            val = float(value)
+        except ValueError:
+            passthrough.append(line)
+            continue
+        if series not in totals:
+            totals[series] = 0.0
+            order.append(series)
+        totals[series] += val
+    out = ['%s %g' % (series, totals[series]) for series in order]
+    out.extend(passthrough)
+    return out

@@ -126,6 +126,19 @@ class Scenario:
                 record['slowest_traces'] = [
                     [json.loads(line) for line in t.ndjson_lines()]
                     for t in done[:3]]
+                # Phase ledger of the same slowest claims: the dump
+                # answers "queue wait or service time?" without the
+                # reader re-deriving it from raw spans. Pure replay
+                # arithmetic — no sampler under VirtualClock.
+                from .. import profile as mod_profile
+                ledgers = mod_profile.phase_ledger(done)
+                if ledgers:
+                    record['phase_ledger'] = {
+                        'summary': mod_profile.ledger_summary(ledgers),
+                        'slowest_claims': sorted(
+                            ledgers, key=lambda led: led['wall_ms'],
+                            reverse=True)[:3],
+                    }
             except Exception:
                 pass  # the dump must never mask the original error
         health = sys.modules.get('cueball_tpu.parallel.health')

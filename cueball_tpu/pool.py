@@ -48,6 +48,10 @@ LP_INT = round(1000 / LP_RATE)
 # (re-exported here for back-compat; see codel.py for the rationale).
 CODEL_PACE = mod_codel.CODEL_PACE
 
+# Bound to cueball_tpu.profile while its sampler runs, so SIGPROF
+# samples landing inside the CoDel pacer attribute to the codel phase.
+_prof = None
+
 # Fleet-actuation advisory freshness bound (ms): ~5 sampler ticks at
 # the default 200 ms cadence. Older advisories are ignored and the
 # pool's own filter governs again.
@@ -534,6 +538,16 @@ class ConnectionPool(FSM):
                    self.p_codel.cd_targdelay)
 
     def _codel_pace(self) -> None:
+        prof = _prof
+        if prof is None:
+            return self._codel_pace_body()
+        tok = prof.push_phase('codel')
+        try:
+            return self._codel_pace_body()
+        finally:
+            prof.pop_phase(tok)
+
+    def _codel_pace_body(self) -> None:
         self.p_codel_pacer = None
         if self.p_codel is None or \
                 self.is_in_state('stopping') or self.is_in_state('stopped'):

@@ -54,6 +54,12 @@ __all__ = ['defer', 'pump_enabled', 'set_pump_enabled', 'pump_depth',
            'wheel_arm', 'wheel_cancel', 'wheel_depth',
            'WHEEL_QUANTUM_MS']
 
+# Bound to cueball_tpu.profile while its sampler runs, so SIGPROF
+# samples landing mid-pump attribute to the runq_pump phase (the
+# native engine's pump marks the phase in C; this seam covers the
+# pure fallback).
+_prof = None
+
 
 if _native is not None:
     defer = _native.pump_defer
@@ -77,16 +83,22 @@ else:
         entries = _pending.pop(loop, None)
         if entries is None:
             return
-        for entry in entries:
-            try:
-                entry[0](*entry[1:])
-            except (SystemExit, KeyboardInterrupt):
-                raise
-            except BaseException as exc:
-                loop.call_exception_handler({
-                    'message': 'cueball runq deferral',
-                    'exception': exc,
-                })
+        prof = _prof
+        tok = prof.push_phase('runq_pump') if prof is not None else None
+        try:
+            for entry in entries:
+                try:
+                    entry[0](*entry[1:])
+                except (SystemExit, KeyboardInterrupt):
+                    raise
+                except BaseException as exc:
+                    loop.call_exception_handler({
+                        'message': 'cueball runq deferral',
+                        'exception': exc,
+                    })
+        finally:
+            if prof is not None:
+                prof.pop_phase(tok)
 
     def defer(cb, *args):
         """Schedule ``cb(*args)`` for the next loop iteration on the

@@ -525,6 +525,37 @@ class FleetRouter:
         from ..parallel.health import reduce_health
         return reduce_health(records)
 
+    def _profile_shard(self, shard_id: int):
+        # Runs inside the shard loop: fold the shard's completed claim
+        # traces into one mergeable cost-attribution record. Thread
+        # shards share the process trace ring, so the record filters by
+        # the shard stamp the claim spans already carry.
+        from .. import profile as mod_profile
+        return mod_profile.profile_record(shard=shard_id)
+
+    async def profile_fleet(self):
+        """One profile pass: each running shard folds its phase
+        ledgers into a cost-attribution record on its own loop, then
+        the records merge shard->host with
+        :func:`profile.reduce_profile` (totals sum, coverage re-derived
+        wall-weighted) — the same reduction shape as
+        :meth:`health_fleet`. Not offered for the spawn backend
+        (children expose /kang/profile and /metrics; merge their
+        scrapes with metrics.merge_expositions)."""
+        if self.fr_backend == 'spawn':
+            raise CueBallError(
+                'profile_fleet is not available on the spawn backend; '
+                'scrape the children and merge with merge_expositions')
+        records = []
+        for sid, fsm in sorted(self.fr_fsms.items()):
+            if not fsm.is_in_state('running'):
+                continue
+            rec = await self.run_on(sid, self._profile_shard, sid)
+            if rec:
+                records.append(rec)
+        from .. import profile as mod_profile
+        return mod_profile.reduce_profile(records)
+
     async def sample_fleet(self, mesh=None, mesh_axes=('host', 'chip')):
         """One per-shard FleetSampler pass each on its own loop, then
         the shard->host reduction (and host->mesh when ``mesh`` is

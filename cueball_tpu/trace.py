@@ -88,6 +88,13 @@ TRACE_HISTOGRAMS = {
         'DNS lookup round-trip time (ms)',
 }
 
+# Per-phase claim cost, fed from the profile module's phase ledger at
+# completion time (labelled, so declared separately from the plain
+# histograms above).
+PHASE_HISTOGRAM = 'cueball_claim_phase_ms'
+PHASE_HISTOGRAM_HELP = ('Per-claim time attributed to one claim-path '
+                        'phase by the profile ledger (ms)')
+
 SHED_COUNTER = 'cueball_codel_shed_total'
 SHED_HELP = 'Claims shed by CoDel admission control, by reason'
 
@@ -185,6 +192,13 @@ def backend_key_for(index: int) -> str | None:
     if not 0 <= index < len(_BACKEND_KEYS):
         return None
     return _BACKEND_KEYS[index]
+
+
+def backend_known(key) -> bool:
+    """True when the backend key has ever been registered (seen by a
+    trace or telemetry path) — lets /kang/traces reject filters naming
+    backends that never existed instead of returning an empty body."""
+    return str(key or '') in _BACKEND_IDS
 
 
 def _backend_from_flags(flags: int) -> str | None:
@@ -603,6 +617,7 @@ class _TraceRuntime:
         if collector is not None:
             for name, help_ in TRACE_HISTOGRAMS.items():
                 collector.histogram(name, help=help_)
+            collector.histogram(PHASE_HISTOGRAM, help=PHASE_HISTOGRAM_HELP)
             collector.counter(SHED_COUNTER, help=SHED_HELP)
             collector.counter(RING_DROPPED_COUNTER,
                               help=RING_DROPPED_HELP)
@@ -744,6 +759,14 @@ class _TraceRuntime:
                 self.observe('cueball_handshake_ms', totals['handshake'])
             if 'lease' in totals:
                 self.observe('cueball_lease_held_ms', totals['lease'])
+            from . import profile as mod_profile
+            led = mod_profile.claim_ledger(trace)
+            if led is not None:
+                hist = self.tr_collector.histogram(
+                    PHASE_HISTOGRAM, help=PHASE_HISTOGRAM_HELP)
+                for phase, ms in led['phases'].items():
+                    if ms > 0.0:
+                        hist.observe(ms, labels={'phase': phase})
         elif isinstance(trace, DnsTrace):
             self.observe('cueball_dns_lookup_ms', trace.root.duration())
 

@@ -29,6 +29,9 @@
 #include <structmember.h>
 #include <stdint.h>
 #include <time.h>
+#include <string.h>
+#include <signal.h>
+#include <sys/time.h>
 
 /* Python < 3.12 compatibility: the single-object exception API this
    file uses landed in 3.12. Express it via the legacy Fetch/Restore
@@ -1441,6 +1444,68 @@ sched_prune_closed(PyObject *map)
 }
 
 /* ------------------------------------------------------------------ */
+/* Sampling claim-path profiler (cueball_tpu/profile.py's native half).
+
+   A SIGPROF-driven wall/CPU sampler: the engine keeps a cheap phase
+   tag (one sig_atomic_t store at sites the hot path already visits —
+   trace_emit's event-code map, the pump drain, FSM transitions) and
+   the signal handler appends ONE fixed-width (phase, site, t) slot to
+   a second preallocated overwrite-oldest ring.  The handler touches
+   no Python state — clock_gettime + plain C stores only — so it is
+   async-signal-safe; everything Python-visible (configure / start /
+   stop / drain) runs under the GIL with SIGPROF blocked around the
+   ring copy.  The ring is separate from the trace ring: the trace
+   ring records *events* the replayer turns into spans, this one
+   records *samples* the profiler turns into flamegraph weights.
+
+   Phase numbering is the profile.PHASES contract; keep in sync. */
+
+#define PROF_PHASE_OTHER       0
+#define PROF_PHASE_QUEUE_WAIT  1
+#define PROF_PHASE_CODEL       2
+#define PROF_PHASE_RUNQ_PUMP   3
+#define PROF_PHASE_FSM         4
+#define PROF_PHASE_SOCKET_WAIT 5
+#define PROF_PHASE_HANDSHAKE   6
+#define PROF_PHASE_LEASE       7
+#define PROF_PHASE_COUNT       8
+
+typedef struct {
+    uint32_t ps_phase;
+    uint32_t ps_site;   /* last TREV_* event code seen (coarse frame id) */
+    double ps_t;        /* CLOCK_MONOTONIC ms at sample time             */
+} ProfSlot;
+
+static ProfSlot *prof_slots = NULL;
+static Py_ssize_t prof_cap = 0;
+static volatile uint64_t prof_head = 0;   /* next write position     */
+static volatile uint64_t prof_tail = 0;   /* oldest undrained slot   */
+static volatile unsigned long long prof_dropped = 0;
+static volatile sig_atomic_t prof_running = 0;
+static volatile sig_atomic_t prof_phase = PROF_PHASE_OTHER;
+static volatile sig_atomic_t prof_site = 0;
+static struct sigaction prof_old_action;
+
+static void
+prof_sigprof_handler(int signo)
+{
+    (void)signo;
+    if (!prof_running || prof_cap == 0)
+        return;
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    if ((Py_ssize_t)(prof_head - prof_tail) == prof_cap) {
+        prof_tail++;
+        prof_dropped++;
+    }
+    ProfSlot *s = &prof_slots[prof_head % (uint64_t)prof_cap];
+    s->ps_phase = (uint32_t)prof_phase;
+    s->ps_site = (uint32_t)prof_site;
+    s->ps_t = (double)ts.tv_sec * 1000.0 + (double)ts.tv_nsec / 1e6;
+    prof_head++;
+}
+
+/* ------------------------------------------------------------------ */
 /* Single-pump engine run queue.
 
    The reference emits stateChanged via setImmediate (mooremachine) and
@@ -1510,6 +1575,13 @@ pump_drain(PyObject *mod, PyObject *loop)
     }
     Py_INCREF(loop);
 
+    /* Sampler phase tag: everything delivered from the batch below is
+       run-queue pump work unless a finer-grained site (FSM transition,
+       trace event) retags from inside the delivery. */
+    sig_atomic_t prof_saved = prof_phase;
+    if (prof_running)
+        prof_phase = PROF_PHASE_RUNQ_PUMP;
+
     Py_ssize_t n = PyList_GET_SIZE(batch);
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *entry = PyList_GET_ITEM(batch, i);
@@ -1534,6 +1606,8 @@ pump_drain(PyObject *mod, PyObject *loop)
         }
         sched_route_exception(loop, blame, msg);
     }
+    if (prof_running)
+        prof_phase = prof_saved;
     Py_DECREF(batch);
     Py_DECREF(loop);
     Py_RETURN_NONE;
@@ -2038,13 +2112,27 @@ fail:
     return NULL;
 }
 
+/* fsm_run_transition_impl with the sampler's FSM phase tag wrapped
+   around it; the common entry for both dispatch paths below. */
+static PyObject *
+fsm_run_transition_phased(PyObject *fsm, PyObject *state)
+{
+    if (!prof_running)
+        return fsm_run_transition_impl(fsm, state);
+    sig_atomic_t saved = prof_phase;
+    prof_phase = PROF_PHASE_FSM;
+    PyObject *r = fsm_run_transition_impl(fsm, state);
+    prof_phase = saved;
+    return r;
+}
+
 static PyObject *
 fsm_run_transition(PyObject *mod, PyObject *args)
 {
     PyObject *fsm, *state;
     if (!PyArg_ParseTuple(args, "OO", &fsm, &state))
         return NULL;
-    return fsm_run_transition_impl(fsm, state);
+    return fsm_run_transition_phased(fsm, state);
 }
 
 /* C port of FSM._check_transition: validate `state` against the
@@ -2117,7 +2205,7 @@ static PyObject *
 fsm_dispatch_run_transition(PyObject *fsm, PyObject *state)
 {
     if (fsm_type_uses_stock(fsm, str_run_transition, fsm_run_thin))
-        return fsm_run_transition_impl(fsm, state);
+        return fsm_run_transition_phased(fsm, state);
     return PyObject_CallMethodObjArgs(fsm, str_run_transition, state,
                                       NULL);
 }
@@ -2349,6 +2437,37 @@ static void
 trace_emit(uint64_t serial, uint32_t code, uint32_t flags,
            double t, double a, double b, PyObject *obj)
 {
+    /* Sampler phase tag: the trace events the hot path already emits
+       double as phase boundaries, so profiling adds zero new
+       instrumentation sites.  CLAIMING starts the backend handshake,
+       CLAIMED starts the lease, terminals drop back to "other"; the
+       event code rides along as the sample's coarse site id. */
+    if (prof_running) {
+        switch (code) {
+        case TREV_CODEL:
+            prof_phase = PROF_PHASE_CODEL;
+            break;
+        case TREV_CLAIM_BEGIN:
+        case TREV_SLOT:
+        case TREV_REQUEUED:
+            prof_phase = PROF_PHASE_QUEUE_WAIT;
+            break;
+        case TREV_CLAIMING:
+            prof_phase = PROF_PHASE_HANDSHAKE;
+            break;
+        case TREV_CLAIMED:
+            prof_phase = PROF_PHASE_LEASE;
+            break;
+        case TREV_RELEASED:
+        case TREV_FAILED:
+        case TREV_CANCELLED:
+            prof_phase = PROF_PHASE_OTHER;
+            break;
+        default:
+            break;
+        }
+        prof_site = (sig_atomic_t)code;
+    }
     if (trace_cap == 0) {
         Py_XDECREF(obj);
         return;
@@ -3055,6 +3174,179 @@ pump_depth(PyObject *mod, PyObject *noargs)
 }
 
 /* ------------------------------------------------------------------ */
+/* Sampling profiler: the Python-visible control surface.  The ring
+   and handler live near the top of the file (the pump/FSM/trace hooks
+   need the globals in scope); everything here runs under the GIL.     */
+
+static PyObject *
+prof_configure(PyObject *mod, PyObject *arg)
+{
+    (void)mod;
+    Py_ssize_t cap = PyNumber_AsSsize_t(arg, PyExc_OverflowError);
+    if (cap == -1 && PyErr_Occurred())
+        return NULL;
+    if (cap < 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "profiler ring capacity must be >= 0");
+        return NULL;
+    }
+    if (prof_running) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "stop the sampler before resizing its ring");
+        return NULL;
+    }
+    if (prof_cap > 0)
+        PyMem_Free(prof_slots);
+    prof_slots = NULL;
+    prof_cap = 0;
+    prof_head = prof_tail = 0;
+    prof_dropped = 0;
+    if (cap > 0) {
+        prof_slots = PyMem_Calloc((size_t)cap, sizeof(ProfSlot));
+        if (prof_slots == NULL)
+            return PyErr_NoMemory();
+        prof_cap = cap;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+prof_start(PyObject *mod, PyObject *arg)
+{
+    (void)mod;
+    long interval_us = PyLong_AsLong(arg);
+    if (interval_us == -1 && PyErr_Occurred())
+        return NULL;
+    if (interval_us <= 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "sampling interval must be > 0 microseconds");
+        return NULL;
+    }
+    if (prof_running)
+        Py_RETURN_FALSE;
+    if (prof_cap == 0) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "prof_configure() a ring before prof_start()");
+        return NULL;
+    }
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = prof_sigprof_handler;
+    sigemptyset(&sa.sa_mask);
+    sa.sa_flags = SA_RESTART;
+    if (sigaction(SIGPROF, &sa, &prof_old_action) != 0)
+        return PyErr_SetFromErrno(PyExc_OSError);
+    struct itimerval it;
+    it.it_interval.tv_sec = interval_us / 1000000;
+    it.it_interval.tv_usec = interval_us % 1000000;
+    it.it_value = it.it_interval;
+    if (setitimer(ITIMER_PROF, &it, NULL) != 0) {
+        sigaction(SIGPROF, &prof_old_action, NULL);
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    prof_running = 1;
+    Py_RETURN_TRUE;
+}
+
+static PyObject *
+prof_stop(PyObject *mod, PyObject *noargs)
+{
+    (void)mod;
+    (void)noargs;
+    if (!prof_running)
+        Py_RETURN_FALSE;
+    prof_running = 0;
+    struct itimerval it;
+    memset(&it, 0, sizeof(it));
+    setitimer(ITIMER_PROF, &it, NULL);
+    sigaction(SIGPROF, &prof_old_action, NULL);
+    prof_phase = PROF_PHASE_OTHER;
+    prof_site = 0;
+    Py_RETURN_TRUE;
+}
+
+/* Tag the current engine phase from Python (pool.py's CoDel pacer,
+   connection_fsm's socket wait) — callers save/restore the returned
+   previous phase.  The native hooks tag C-side sites; this seam covers
+   the phases whose code is Python under both engines. */
+static PyObject *
+prof_set_phase(PyObject *mod, PyObject *arg)
+{
+    (void)mod;
+    long phase = PyLong_AsLong(arg);
+    if (phase == -1 && PyErr_Occurred())
+        return NULL;
+    if (phase < 0 || phase >= PROF_PHASE_COUNT) {
+        PyErr_SetString(PyExc_ValueError, "unknown profiler phase");
+        return NULL;
+    }
+    long prev = (long)prof_phase;
+    prof_phase = (sig_atomic_t)phase;
+    return PyLong_FromLong(prev);
+}
+
+/* Pop every pending sample as (phase, site, t_ms) tuples, oldest
+   first.  SIGPROF is blocked around the raw copy so the handler can
+   never interleave with the indices being read; the Python objects
+   are built after the mask is restored. */
+static PyObject *
+prof_drain(PyObject *mod, PyObject *noargs)
+{
+    (void)mod;
+    (void)noargs;
+    sigset_t block, old;
+    sigemptyset(&block);
+    sigaddset(&block, SIGPROF);
+    sigprocmask(SIG_BLOCK, &block, &old);
+    Py_ssize_t n = (Py_ssize_t)(prof_head - prof_tail);
+    ProfSlot *tmp = NULL;
+    if (n > 0) {
+        tmp = PyMem_Malloc((size_t)n * sizeof(ProfSlot));
+        if (tmp != NULL) {
+            for (Py_ssize_t i = 0; i < n; i++)
+                tmp[i] = prof_slots[
+                    (prof_tail + (uint64_t)i) % (uint64_t)prof_cap];
+            prof_tail = prof_head;
+        }
+    }
+    sigprocmask(SIG_SETMASK, &old, NULL);
+    if (n == 0)
+        return PyList_New(0);
+    if (tmp == NULL)
+        return PyErr_NoMemory();
+    PyObject *out = PyList_New(n);
+    if (out == NULL) {
+        PyMem_Free(tmp);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *tup = Py_BuildValue(
+            "(IId)", tmp[i].ps_phase, tmp[i].ps_site, tmp[i].ps_t);
+        if (tup == NULL) {
+            PyMem_Free(tmp);
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, tup);
+    }
+    PyMem_Free(tmp);
+    return out;
+}
+
+static PyObject *
+prof_stats(PyObject *mod, PyObject *noargs)
+{
+    (void)mod;
+    (void)noargs;
+    return Py_BuildValue(
+        "{s:n,s:n,s:K,s:O}",
+        "capacity", prof_cap,
+        "pending", (Py_ssize_t)(prof_head - prof_tail),
+        "dropped", (unsigned long long)prof_dropped,
+        "running", prof_running ? Py_True : Py_False);
+}
+
+/* ------------------------------------------------------------------ */
 /* module                                                              */
 
 static PyMethodDef native_methods[] = {
@@ -3097,6 +3389,20 @@ static PyMethodDef native_methods[] = {
     {"trace_set_shard", (PyCFunction)trace_set_shard, METH_O,
      "trace_set_shard(shard_id): stamp this thread's trace slots with "
      "a FleetRouter shard id (bits 8+ of flags, +1 biased; -1 clears)."},
+    {"prof_configure", (PyCFunction)prof_configure, METH_O,
+     "Size (or, with 0, tear down) the sampling-profiler ring."},
+    {"prof_start", (PyCFunction)prof_start, METH_O,
+     "prof_start(interval_us): arm SIGPROF sampling at the given "
+     "interval; returns False if already running."},
+    {"prof_stop", (PyCFunction)prof_stop, METH_NOARGS,
+     "Disarm the SIGPROF sampler and restore the previous handler."},
+    {"prof_set_phase", (PyCFunction)prof_set_phase, METH_O,
+     "prof_set_phase(phase) -> previous phase: tag the engine phase "
+     "the sampler attributes subsequent samples to."},
+    {"prof_drain", (PyCFunction)prof_drain, METH_NOARGS,
+     "Pop every pending sample as (phase, site, t_ms), oldest first."},
+    {"prof_stats", (PyCFunction)prof_stats, METH_NOARGS,
+     "Sampler stats: {capacity, pending, dropped, running}."},
     {"handle_free_push", (PyCFunction)handle_free_push, METH_O,
      "Stash a terminal claim handle for recycling."},
     {"handle_free_pop", (PyCFunction)handle_free_pop, METH_NOARGS,

@@ -158,6 +158,14 @@ def test_gray_detector_names_seeded_backends_zero_false_positives(seed):
             ticker.cancel()
             monitor.stop()
             mod_trace.remove_backend_sink(counts)
+        # Phase ledgers of the post-onset claims, while the ring is
+        # still live (pure replay arithmetic: no clock reads, so the
+        # seeded schedule replays byte-identically with or without
+        # this read).
+        from cueball_tpu import profile as mod_profile
+        result['ledgers'] = mod_profile.phase_ledger(
+            [t for t in mod_trace.trace_ring()
+             if t.root.end is not None and t.root.start >= 2000.0])
         await sco.stop_pool(pool, res)
 
     try:
@@ -187,6 +195,33 @@ def test_gray_detector_names_seeded_backends_zero_false_positives(seed):
     ok_rate = (sum(1 for r in result['outcomes'] if r['ok'])
                / len(result['outcomes']))
     assert ok_rate >= 0.99, ok_rate
+
+    # Phase-ledger envelope (the claim-path profiler over the same
+    # ring the detector read): gray failure is SERVICE-TIME inflation.
+    # Claims attributed to the seeded backends show it in the lease
+    # phase — the simulated request served 100x slower under the held
+    # claim — while their queue_wait stays a minority share (healthy
+    # capacity keeps absorbing the queue; a pool that piled claims
+    # into the queue behind gray leases would show the inverse).
+    ledgers = result['ledgers']
+    assert len(ledgers) > 50
+    for led in ledgers:
+        assert abs(sum(led['phases'].values()) - led['wall_ms']) <= \
+            max(1e-6, 1e-9 * led['wall_ms'])
+        assert led['coverage'] >= 0.95, led
+    gray_leds = [led for led in ledgers if led['backend'] in seeded]
+    healthy_leds = [led for led in ledgers
+                    if led['backend'] not in seeded]
+    assert gray_leds and healthy_leds
+    gray_lease = netsim.quantile(
+        [led['phases']['lease'] for led in gray_leds], 0.50)
+    healthy_lease = netsim.quantile(
+        [led['phases']['lease'] for led in healthy_leds], 0.50)
+    gray_queue = netsim.quantile(
+        [led['phases']['queue_wait'] for led in gray_leds], 0.50)
+    assert gray_lease >= 10.0 * max(healthy_lease, 1.0), (
+        gray_lease, healthy_lease)
+    assert gray_queue < gray_lease, (gray_queue, gray_lease)
 
 
 def test_failure_dump_embeds_health_verdict_history(
@@ -239,3 +274,13 @@ def test_failure_dump_embeds_health_verdict_history(
                   'alert_page'):
         assert field in entry, entry
     assert dump['health']['fleet'] is not None
+    # The replay dump embeds the claims' phase ledgers too (ISSUE 13):
+    # the summary cost attribution plus the slowest claims, so a
+    # failure dump answers "where did the wall time go" offline.
+    ledger = dump['phase_ledger']
+    assert ledger['summary']['claims'] >= 5
+    assert ledger['summary']['coverage'] >= 0.95
+    assert ledger['slowest_claims']
+    led = ledger['slowest_claims'][0]
+    for field in ('trace_id', 'wall_ms', 'phases', 'coverage'):
+        assert field in led, sorted(led)

@@ -122,3 +122,30 @@ def test_regional_failover_recovery_envelope(seed):
     result['recovery_from_spans_s'] = (recovered_at - 5000.0) / 1000.0
     assert result['recovery_from_spans_s'] < 2.5, result[
         'recovery_from_spans_s']
+
+    # Phase-ledger envelope (the claim-path profiler over the same
+    # span record): the ledger partitions every claim's wall time —
+    # phase_sum == wall, coverage >= 0.95 under virtual time. During
+    # the partition the pool serves from warm spares in the healthy
+    # regions, so the ledger must show NO inflation at all: every
+    # window claim stays under the single-claim-timeout bound, and any
+    # claim that does go slow owes it to waiting (queue_wait plus the
+    # carved-out socket_wait of blackholed handshakes), never to
+    # service time — the inverse of the gray-failure signature.
+    from cueball_tpu import profile as mod_profile
+    ledgers = mod_profile.phase_ledger(claims)
+    assert len(ledgers) == len(claims)
+    for led in ledgers:
+        assert abs(sum(led['phases'].values()) - led['wall_ms']) <= \
+            max(1e-6, 1e-9 * led['wall_ms'])
+        assert led['coverage'] >= 0.95, led
+    window = [led for t, led in zip(claims, ledgers)
+              if 5000.0 <= t.root.start < 25000.0]
+    assert window, 'no ledgered claims inside the partition window'
+    for led in window:
+        assert led['wall_ms'] <= 1100.0, led
+        if led['wall_ms'] > 100.0:
+            waiting = led['phases']['queue_wait'] + \
+                led['phases']['socket_wait']
+            assert waiting >= 0.5 * led['wall_ms'], led
+            assert led['phases']['lease'] <= 0.5 * led['wall_ms'], led

@@ -8,12 +8,25 @@ import os
 import re
 import signal
 
+import pytest
+
 import cueball_tpu as cb
 from cueball_tpu import debug as mod_debug
+from cueball_tpu import profile as mod_profile
 from cueball_tpu import utils as mod_utils
 from cueball_tpu.events import EventEmitter
 
 from conftest import run_async
+
+
+@pytest.fixture(autouse=True)
+def _sampler_off():
+    """The SIGUSR2 toggle doubles as the profiler attach point, so any
+    test flipping it an odd number of times would leak a running
+    SIGPROF sampler (and its accumulated samples) into the suite."""
+    yield
+    mod_profile.stop_sampler()
+    mod_profile.reset_samples()
 
 
 class InstantConnection(EventEmitter):
@@ -248,6 +261,62 @@ def test_signal_dump_includes_trace_ring(caplog):
     run_async(t())
 
 
+def test_signal_arms_sampler_and_dump_shows_profiler(caplog):
+    """The debug toggle IS the profiler attach point (`make profile`):
+    the first SIGUSR2 arms the SIGPROF sampler, the second disarms it,
+    and the dump that follows carries the profiler section (sampler
+    state + the claims' phase ledgers)."""
+    async def t():
+        from cueball_tpu import trace as mod_trace
+        pool, res = build_pool()
+        await settle(pool)
+        mod_trace.enable_tracing()
+        prev = cb.install_debug_handler(signal.SIGUSR2)
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            await asyncio.sleep(0.05)
+            assert mod_profile.sampler_running()
+            hdl, conn = await pool.claim()
+            hdl.release()
+            await asyncio.sleep(0.02)
+            with caplog.at_level(logging.WARNING, logger='cueball.debug'):
+                os.kill(os.getpid(), signal.SIGUSR2)   # disarm + dump
+                await asyncio.sleep(0.05)
+            assert not mod_profile.sampler_running()
+        finally:
+            mod_debug.uninstall_debug_handler(prev, signal.SIGUSR2)
+            mod_utils.disable_stack_traces()
+            mod_trace.disable_tracing()
+        dumps = [r.getMessage() for r in caplog.records
+                 if 'debug signal' in r.getMessage()]
+        # The first delivery's dump shows the sampler armed; the
+        # disarming delivery's dump shows it stopped, with the claim's
+        # ledger alongside.
+        assert 'sampler: running engine=' in dumps[0]
+        dump = dumps[-1]
+        assert dump is not dumps[0]
+        assert '-- claim-path profiler --' in dump
+        assert re.search(r'sampler: stopped samples=\d+', dump)
+        assert 'ledger:' in dump and 'coverage=' in dump
+        pool.stop()
+    run_async(t())
+
+
+def test_dump_omits_profiler_section_when_idle():
+    """Sampler never armed, no completed claims: the profiler section
+    is absent and the dump is otherwise unchanged (absent-but-
+    well-formed, like the health and trace sections)."""
+    async def t():
+        pool, res = build_pool()
+        await settle(pool)
+        report = cb.dump_fsm_histories()
+        assert '-- claim-path profiler --' not in report
+        assert 'domain=debug.test' in report
+        assert '(pool)' in report and 'state=running' in report
+        pool.stop()
+    run_async(t())
+
+
 def test_signal_dump_defers_to_running_loop(caplog):
     """With an asyncio loop running, _on_debug_signal must NOT dump
     inline (buffered log writes are not reentrancy-safe at interrupt
@@ -314,6 +383,10 @@ def test_dump_renders_spawn_router_and_health_with_dead_child():
             router.fr_workers[dead]._proc.terminate()
             router.fr_workers[dead]._proc.join(timeout=10)
 
+            # Arm the sampler too: the profiler section must render
+            # from parent-side state even with a corpse in the fleet.
+            assert mod_profile.start_sampler()
+
             t0 = mod_time.monotonic()
             report = cb.dump_fsm_histories()
             # Parent-side state only: never an IPC round-trip, so the
@@ -325,9 +398,12 @@ def test_dump_renders_spawn_router_and_health_with_dead_child():
                 r'pool svc\.dump\s+-> shard %d' % rec.shard_id, report)
             assert '-- fleet health (1 monitor(s)) --' in report
             assert re.search(r'epoch=1 backends=\d+ gray=-', report)
+            assert '-- claim-path profiler --' in report
+            assert re.search(r'sampler: running engine=\w+', report)
         finally:
             if monitor is not None:
                 monitor.stop()
+            mod_profile.stop_sampler()
             try:
                 await router.stop()
             except Exception:

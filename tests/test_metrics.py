@@ -63,10 +63,43 @@ class TestMergeExpositions:
         c.gauge('cueball_up', 'liveness').set(1.0)
         merged = merge_expositions([c.collect(), c.collect()])
         assert merged.count('# TYPE cueball_claim_ms histogram') == 1
-        # _bucket/_sum/_count rows double (two scrapes) but never pull
-        # in a second header.
+        # Identical histogram series FOLD (sum) instead of repeating:
+        # one row per bucket, counts doubled across the two scrapes.
         assert merged.count('cueball_claim_ms_bucket{') == \
-            2 * (len(mod_metrics.DEFAULT_BUCKETS) + 1)
+            len(mod_metrics.DEFAULT_BUCKETS) + 1
+        assert 'cueball_claim_ms_count{shard="0"} 2' in merged
+        assert 'cueball_claim_ms_sum{shard="0"} 24' in merged
+
+    def test_histogram_buckets_fold_across_children(self):
+        def child(values):
+            c = Collector()
+            h = c.histogram('cueball_claim_phase_ms', 'phase cost')
+            for v in values:
+                h.observe(v, {'phase': 'queue_wait'})
+            return c.collect()
+
+        merged = merge_expositions([child([0.3, 40.0]), child([0.4])])
+        # Cumulative buckets sum per (label set, le): three observes
+        # total, two at/below 0.5.
+        assert ('cueball_claim_phase_ms_bucket{phase="queue_wait",'
+                'le="0.5"} 2') in merged
+        assert ('cueball_claim_phase_ms_bucket{phase="queue_wait",'
+                'le="+Inf"} 3') in merged
+        assert ('cueball_claim_phase_ms_count{phase="queue_wait"} 3'
+                in merged)
+        # Distinct label sets stay distinct.
+        merged2 = merge_expositions(
+            [child([1.0]),
+             child([1.0]).replace('queue_wait', 'lease')])
+        assert 'phase="queue_wait"' in merged2
+        assert 'phase="lease"' in merged2
+
+    def test_histogram_fold_is_idempotent(self):
+        c = Collector()
+        c.histogram('cueball_claim_ms', 'claim latency').observe(5.0)
+        texts = [c.collect(), c.collect()]
+        once = merge_expositions(texts)
+        assert merge_expositions([once]) == once
 
     def test_first_declaration_wins_help_text(self):
         a = '# HELP m from_a\n# TYPE m gauge\nm 1\n'

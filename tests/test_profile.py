@@ -1,0 +1,332 @@
+"""Claim-path profiler (cueball_tpu/profile.py): phase-ledger
+invariants (phase_sum ~= wall, coverage >= 0.95 on the fast and queued
+paths under both recorders), flamegraph byte-identity native vs pure
+on a seeded netsim run, SIGPROF sampler lifecycle + netsim
+auto-disable, the per-shard record merge, and the surfaced histograms
+on /metrics."""
+
+import asyncio
+
+import pytest
+
+import cueball_tpu as cb
+from cueball_tpu import metrics as mod_metrics
+from cueball_tpu import profile as mod_profile
+from cueball_tpu import trace as mod_trace
+from cueball_tpu import utils as mod_utils
+
+from conftest import run_async
+from test_debug import build_pool, settle
+
+
+@pytest.fixture(autouse=True)
+def _profiler_off():
+    """Tracing and the sampler are process-global: never leak either
+    (or accumulated sample counts) across tests."""
+    yield
+    mod_profile.stop_sampler()
+    mod_profile.reset_samples()
+    mod_profile._samples.clear()
+    mod_trace.disable_tracing()
+
+
+async def _run_claims(pool, n, queued=False):
+    if not queued:
+        for _ in range(n):
+            hdl, conn = await pool.claim({'timeout': 1000})
+            hdl.release()
+        return
+    done = asyncio.Event()
+    count = [0]
+
+    def make_claim():
+        def cb(err, hdl=None, conn=None):
+            assert err is None, err
+            count[0] += 1
+            hdl.release()
+            if count[0] >= n:
+                if not done.is_set():
+                    done.set()
+                return
+            make_claim()
+        pool.claim_cb({}, cb)
+
+    for _ in range(min(8, n)):
+        make_claim()
+    await done.wait()
+
+
+def _ledger_run(native, queued):
+    async def t():
+        mod_trace.enable_tracing(ring_size=256, sample_rate=1.0,
+                                 native=native)
+        pool, res = build_pool()
+        await settle(pool)
+        await _run_claims(pool, 50, queued=queued)
+        await asyncio.sleep(0.05)
+        ledgers = mod_profile.phase_ledger()
+        pool.stop()
+        return ledgers
+    return run_async(t())
+
+
+@pytest.mark.parametrize('queued', [False, True])
+@pytest.mark.parametrize('native', [
+    pytest.param(True, marks=pytest.mark.skipif(
+        not mod_trace._NATIVE_TRACE_OK, reason='C engine not loaded')),
+    False])
+def test_ledger_phase_sum_and_coverage(native, queued):
+    """The tentpole invariant: per claim, the named phases partition
+    wall time (sum == wall up to float addition) and coverage sits at
+    >= 0.95 on the fast AND the queued path, under both recorders."""
+    ledgers = _ledger_run(native, queued)
+    assert len(ledgers) >= 50
+    for led in ledgers:
+        total = sum(led['phases'].values())
+        assert abs(total - led['wall_ms']) <= \
+            max(1e-6, 1e-9 * led['wall_ms'])
+        assert set(led['phases']) == set(mod_profile.PHASES)
+        assert led['coverage'] >= 0.95, led
+        assert led['outcome'] == 'released'
+    summ = mod_profile.ledger_summary(ledgers)
+    assert summ['claims'] == len(ledgers)
+    assert summ['coverage'] >= 0.95
+    # The sampler-attributed columns are present (non-null) even when
+    # the sampler never ran.
+    for phase in ('codel', 'runq_pump', 'fsm'):
+        assert summ['phase_ms'][phase] == 0.0
+
+
+def test_claim_ledger_rejects_open_and_foreign_traces():
+    tr = mod_trace.Trace(None, attrs={'kind': 'dns'})
+    assert mod_profile.claim_ledger(tr) is None      # still open
+    tr.root.end = tr.root.start + 1.0
+    assert mod_profile.claim_ledger(tr) is None      # kind != claim
+
+
+def test_ledger_summary_empty():
+    summ = mod_profile.ledger_summary([])
+    assert summ['claims'] == 0 and summ['wall_ms'] == 0.0
+    assert summ['coverage'] == 1.0
+
+
+def test_reduce_profile_merges_shard_records():
+    a = {'claims': 2, 'wall_ms': 10.0,
+         'phase_ms': {'queue_wait': 4.0, 'lease': 6.0},
+         'coverage': 1.0, 'shard': 0}
+    b = {'claims': 1, 'wall_ms': 10.0,
+         'phase_ms': {'queue_wait': 1.0, 'lease': 8.0},
+         'coverage': 0.9, 'shard': 1}
+    merged = mod_profile.reduce_profile([a, b, None])
+    assert merged['n_shards'] == 2
+    assert merged['claims'] == 3
+    assert merged['wall_ms'] == 20.0
+    assert merged['phase_ms']['queue_wait'] == 5.0
+    assert merged['phase_ms']['lease'] == 14.0
+    # Wall-weighted coverage: (10*1.0 + 10*0.9) / 20.
+    assert abs(merged['coverage'] - 0.95) < 1e-9
+    assert merged['shards'] == [a, b]
+
+
+def _seeded_flamegraph(native, seed=1234):
+    from cueball_tpu import netsim
+    from cueball_tpu.pool import ConnectionPool
+    from cueball_tpu.resolver import StaticIpResolver
+
+    fabric = netsim.Fabric()
+
+    async def run():
+        mod_trace.enable_tracing(ring_size=64, sample_rate=1.0,
+                                 native=native)
+        res = StaticIpResolver({'backends': [
+            {'address': '10.0.0.1', 'port': 80},
+            {'address': '10.0.0.2', 'port': 80}]})
+        pool = ConnectionPool({
+            'domain': 'svc.sim',
+            'constructor': fabric.constructor,
+            'resolver': res,
+            'spares': 2,
+            'maximum': 4,
+            'recovery': {'default': {'retries': 2, 'timeout': 500,
+                                     'delay': 100, 'maxDelay': 400}},
+        })
+        res.start()
+        while not pool.is_in_state('running'):
+            await asyncio.sleep(0.05)
+        # The sampler must refuse to arm under the VirtualClock: a
+        # scenario's replay may not depend on host-time signals.
+        assert mod_profile.start_sampler() is False
+        assert 'clock' in \
+            mod_profile.sampler_stats()['disabled_reason']
+        for i in range(6):
+            hdl, conn = await pool.claim({'timeout': 1000.0})
+            await asyncio.sleep(0.005 * (i % 3 + 1))
+            hdl.release()
+        await asyncio.sleep(0.1)
+        text = mod_profile.flamegraph()
+        pool.stop()
+        while not pool.is_in_state('stopped'):
+            await asyncio.sleep(0.05)
+        res.stop()
+        mod_trace.disable_tracing()
+        return text
+
+    return netsim.run(run(), seed=seed)
+
+
+@pytest.mark.skipif(not mod_trace._NATIVE_TRACE_OK,
+                    reason='C engine not loaded')
+def test_flamegraph_native_pure_byte_identity():
+    """Acceptance: on a seeded netsim scenario the /kang/profile
+    payload is byte-identical between the native and pure recorders —
+    the ledger half is pure replay arithmetic and the sampler is
+    auto-disabled, so no host-dependent bytes can leak in."""
+    a = _seeded_flamegraph(native=True)
+    b = _seeded_flamegraph(native=False)
+    assert a == b
+    assert a.startswith('claim;')
+    for line in a.strip().splitlines():
+        stack, _, weight = line.rpartition(' ')
+        assert stack and int(weight) > 0
+
+
+def test_sampler_lifecycle_and_stats():
+    assert not mod_profile.sampler_running()
+    assert mod_profile.start_sampler(interval_ms=2.0) is True
+    assert mod_profile.sampler_running()
+    # Idempotent while running.
+    assert mod_profile.start_sampler() is True
+    stats = mod_profile.sampler_stats()
+    assert stats['running'] and stats['engine'] in ('native', 'pure')
+    # Burn a little CPU so SIGPROF (CPU-time based) fires.
+    t0 = mod_utils.wall_time()
+    while mod_utils.wall_time() - t0 < 0.2:
+        sum(range(500))
+    assert mod_profile.stop_sampler() is True
+    assert not mod_profile.sampler_running()
+    assert mod_profile.stop_sampler() is False
+    assert mod_profile.sampler_stats()['samples'] > 0
+
+
+def test_sampler_phase_seams_bind_and_unbind():
+    from cueball_tpu import connection_fsm as mod_cfsm
+    from cueball_tpu import fsm as mod_fsm
+    from cueball_tpu import pool as mod_pool
+    from cueball_tpu import runq as mod_runq
+    assert mod_profile.start_sampler() is True
+    try:
+        for mod in (mod_pool, mod_cfsm, mod_runq, mod_fsm):
+            assert mod._prof is mod_profile
+        tok = mod_profile.push_phase('codel')
+        mod_profile.pop_phase(tok)
+    finally:
+        mod_profile.stop_sampler()
+    for mod in (mod_pool, mod_cfsm, mod_runq, mod_fsm):
+        assert mod._prof is None
+
+
+def test_push_phase_rejects_unknown_name():
+    with pytest.raises(KeyError):
+        mod_profile.push_phase('not-a-phase')
+
+
+def test_profile_record_filters_by_shard():
+    led_local = {'shard': None, 'wall_ms': 1.0, 'coverage': 1.0,
+                 'phases': {p: 0.0 for p in mod_profile.PHASES}}
+    led_s0 = dict(led_local, shard=0)
+    led_s1 = dict(led_local, shard=1)
+    real = mod_profile.phase_ledger
+
+    def fake_ledger(traces=None):
+        return [dict(led_local), dict(led_s0), dict(led_s1)]
+    mod_profile.phase_ledger = fake_ledger
+    try:
+        rec = mod_profile.profile_record(shard=0)
+        # Unstamped (process-local) claims count for every shard;
+        # other shards' claims do not.
+        assert rec['claims'] == 2
+        assert rec['shard'] == 0
+        assert rec['sampler']['running'] is False
+        rec_all = mod_profile.profile_record()
+        assert rec_all['claims'] == 3 and rec_all['shard'] is None
+    finally:
+        mod_profile.phase_ledger = real
+
+
+def test_phase_histograms_on_metrics():
+    async def t():
+        coll = mod_metrics.create_collector({'component': 'cueball'})
+        mod_trace.enable_tracing(ring_size=64, sample_rate=1.0,
+                                 collector=coll)
+        pool, res = build_pool()
+        await settle(pool)
+        hdl, conn = await pool.claim({'timeout': 1000})
+        await asyncio.sleep(0.02)
+        hdl.release()
+        await asyncio.sleep(0.02)
+        # Force the native ring drain (scrape-time path).
+        mod_trace.trace_ring()
+        text = coll.collect()
+        assert '# TYPE cueball_claim_phase_ms histogram' in text
+        assert 'cueball_claim_phase_ms_bucket{' in text
+        assert 'phase="lease",le="+Inf"' in text
+        assert 'cueball_claim_phase_ms_count{' in text
+        pool.stop()
+    run_async(t())
+
+
+def test_profile_fleet_thread_backend_and_spawn_refusal():
+    from bench import _bench_fixture_pool
+    from cueball_tpu.errors import CueBallError
+    from cueball_tpu.shard import FleetRouter
+    from test_shard_router import _stop_pool_and_router
+
+    async def main():
+        mod_trace.enable_tracing(ring_size=128, sample_rate=1.0)
+        router = FleetRouter({'shards': 2, 'backend': 'thread'})
+        await router.start()
+        await router.create_pool('svc.prof',
+                                 factory=_bench_fixture_pool)
+        for _ in range(5):
+            claim = await router.claim('svc.prof')
+            await claim.release()
+        await asyncio.sleep(0.05)
+        merged = await router.profile_fleet()
+        assert merged['n_shards'] >= 1
+        assert merged['claims'] >= 5
+        assert merged['coverage'] >= 0.95
+        assert set(merged['phase_ms']) == set(mod_profile.PHASES)
+        for rec in merged['shards']:
+            assert rec['shard'] is not None
+            assert 'sampler' in rec
+        await _stop_pool_and_router(router, 'svc.prof')
+    run_async(main())
+
+    async def spawn_refuses():
+        router = FleetRouter({'shards': 1, 'backend': 'spawn'})
+        with pytest.raises(CueBallError):
+            await router.profile_fleet()
+    run_async(spawn_refuses())
+
+
+def test_dump_profile_absent_then_present():
+    # Nothing profiled, no tracing: the section is absent (empty
+    # string), so the SIGUSR2 dump stays well-formed without it.
+    assert mod_profile.dump_profile() == ''
+
+    async def t():
+        mod_trace.enable_tracing(ring_size=64, sample_rate=1.0)
+        pool, res = build_pool()
+        await settle(pool)
+        hdl, conn = await pool.claim({'timeout': 1000})
+        hdl.release()
+        await asyncio.sleep(0.02)
+        text = mod_profile.dump_profile()
+        assert text.startswith('-- claim-path profiler --')
+        assert 'ledger:' in text and 'coverage=' in text
+        pool.stop()
+    run_async(t())
+
+
+def test_flamegraph_empty_without_data():
+    assert mod_profile.flamegraph(traces=[]) == ''

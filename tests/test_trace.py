@@ -477,3 +477,49 @@ def test_engine_matrix_parity_concurrent_claims():
                                         concurrent=True)
     assert len(a.splitlines()) > 40
     assert a == b
+
+
+def test_kang_traces_rejects_malformed_query():
+    """Bad ?limit / ?backend inputs must come back as 400 with a JSON
+    error body naming the offending value — not as a 500, not as a
+    silently-empty 200 (a filter naming a backend that never existed
+    is almost always an operator typo)."""
+    async def t():
+        mod_trace.enable_tracing(ring_size=16, sample_rate=1.0)
+        pool, res = build_pool()
+        await settle(pool)
+        server = await serve_monitor()
+        port = server.sockets[0].getsockname()[1]
+        hdl, conn = await pool.claim({'timeout': 1000})
+        hdl.release()
+        await asyncio.sleep(0.02)
+
+        status, body = await _get(port, '/kang/traces?limit=-1')
+        assert status == 400
+        assert body == {'error': 'limit must be >= 0, got -1'}
+        status, body = await _get(port, '/kang/traces?limit=abc')
+        assert status == 400
+        assert body == {'error': "limit must be an integer, got 'abc'"}
+        status, body = await _get(port, '/kang/traces?backend=no.such')
+        assert status == 400
+        assert body == {'error': "unknown backend 'no.such'"}
+        # One bad parameter rejects even when the other is fine.
+        status, body = await _get(port,
+                                  '/kang/traces?limit=1&backend=no.such')
+        assert status == 400 and 'unknown backend' in body['error']
+
+        # Valid inputs (including the limit=0 edge) still serve.
+        status, text = await _get(port, '/kang/traces?limit=1')
+        assert status == 200 and text.strip()
+        status, text = await _get(port, '/kang/traces?limit=0')
+        assert status == 200 and text == ''
+        claims = [tr for tr in cb.trace_ring()
+                  if tr.root.attrs.get('kind') == 'claim']
+        key = claims[-1].ct_backend
+        status, text = await _get(
+            port, '/kang/traces?backend=%s' % key)
+        assert status == 200 and text.strip()
+
+        server.close()
+        pool.stop()
+    run_async(t())

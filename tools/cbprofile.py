@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""cbprofile — attach the claim-path profiler to a LIVE process.
+
+The SIGUSR2 debug toggle (cueball_tpu/debug.py) doubles as the
+profiler attach point: the first delivery arms the SIGPROF phase
+sampler, the second disarms it, and every delivery dumps the profiler
+section next to the FSM histories. This tool drives that loop from
+outside and scrapes the flamegraph the kang endpoint serves:
+
+    python tools/cbprofile.py <pid> <port> [--seconds N]
+
+sends SIGUSR2 to `pid` (arming the sampler), waits N seconds (default
+2) while the target runs under the sampler, scrapes
+http://127.0.0.1:<port>/kang/profile, prints the collapsed-stack
+flamegraph text to stdout, and sends a second SIGUSR2 to disarm.
+
+    python tools/cbprofile.py --smoke
+
+is the `make profile` / `make ci` self-test: it spawns a throwaway
+child process that runs a small claim workload behind a kang endpoint
+with the debug handler installed, runs the attach loop against it, and
+exits nonzero unless the scrape returns a well-formed flamegraph with
+nonzero ledger weight. Stdlib only, like the other vendored tools.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+_SMOKE_CHILD = r'''
+import asyncio
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import bench
+from cueball_tpu import debug as mod_debug
+from cueball_tpu import metrics as mod_metrics
+from cueball_tpu import trace as mod_trace
+from cueball_tpu.http_server import serve_monitor
+
+
+async def main():
+    mod_debug.install_debug_handler()
+    coll = mod_metrics.create_collector({"component": "cueball"})
+    mod_trace.enable_tracing(ring_size=256, sample_rate=1.0,
+                             collector=coll)
+    pool = bench.make_fixture()()
+    await bench.settle(pool)
+    server = await serve_monitor(collector=coll)
+    port = server.sockets[0].getsockname()[1]
+    print("PORT=%d" % port, flush=True)
+    # Keep claiming until the parent kills us: the sampler it arms
+    # over SIGUSR2 needs a live claim path to sample.
+    while True:
+        hdl, conn = await pool.claim({"timeout": 1000})
+        await asyncio.sleep(0)
+        hdl.release()
+
+
+asyncio.run(main())
+'''
+
+
+def _scrape(port: int, path: str) -> str:
+    with urllib.request.urlopen(
+            'http://127.0.0.1:%d%s' % (port, path), timeout=10) as r:
+        return r.read().decode()
+
+
+def attach(pid: int, port: int, seconds: float = 2.0) -> str:
+    """Arm the target's sampler, let it run, scrape the flamegraph,
+    disarm. Returns the flamegraph text."""
+    os.kill(pid, signal.SIGUSR2)
+    time.sleep(seconds)
+    try:
+        text = _scrape(port, '/kang/profile')
+    finally:
+        os.kill(pid, signal.SIGUSR2)
+    return text
+
+
+def smoke() -> int:
+    child = subprocess.Popen(
+        [sys.executable, '-c', _SMOKE_CHILD],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        text=True)
+    try:
+        line = child.stdout.readline()
+        if not line.startswith('PORT='):
+            print('cbprofile smoke: child failed to start (%r)' % line,
+                  file=sys.stderr)
+            return 1
+        port = int(line.split('=', 1)[1])
+        text = attach(child.pid, port, seconds=1.0)
+        if not text.strip():
+            print('cbprofile smoke: empty /kang/profile payload',
+                  file=sys.stderr)
+            return 1
+        weights = {}
+        for ln in text.strip().splitlines():
+            stack, _, count = ln.rpartition(' ')
+            if not stack or not count.lstrip('-').isdigit():
+                print('cbprofile smoke: malformed flamegraph line %r'
+                      % ln, file=sys.stderr)
+                return 1
+            weights[stack] = weights.get(stack, 0) + int(count)
+        ledger = sum(v for k, v in weights.items()
+                     if k.startswith('claim;'))
+        if ledger <= 0:
+            print('cbprofile smoke: no ledger weight in %r' % text,
+                  file=sys.stderr)
+            return 1
+        print(json.dumps({
+            'ok': True,
+            'stacks': len(weights),
+            'ledger_us': ledger,
+            'sampler_stacks': sum(
+                1 for k in weights if k.startswith('sampler;')),
+        }))
+        return 0
+    finally:
+        child.kill()
+        child.wait()
+
+
+def main(argv) -> int:
+    if '--smoke' in argv:
+        return smoke()
+    args = [a for a in argv if not a.startswith('--')]
+    if len(args) != 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    seconds = 2.0
+    for a in argv:
+        if a.startswith('--seconds='):
+            seconds = float(a.split('=', 1)[1])
+    text = attach(int(args[0]), int(args[1]), seconds=seconds)
+    sys.stdout.write(text)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))
