@@ -417,6 +417,124 @@ async def bench_queued_claim_throughput():
     return statistics.mean(rates), statistics.stdev(rates)
 
 
+# Batched-claim stage: claim_many(64)/release_many against the same
+# 64 looped single claims. Both arms cycle the identical 64 handles
+# through the identical slot FSMs; the only difference is bookkeeping
+# — one options parse, one counter bump, one deferred dispatch and
+# one wheel arm per BATCH instead of per claim — so the delta is a
+# direct read of the per-claim overhead claim_many amortizes.
+CLAIM_MANY_BATCH = 64
+CLAIM_MANY_BATCHES_PER_TRIAL = 125    # x64 = 8000 ops, same as claim
+CLAIM_MANY_TRIALS = 6
+
+
+async def bench_claim_many(batch=CLAIM_MANY_BATCH,
+                           batches=CLAIM_MANY_BATCHES_PER_TRIAL,
+                           trials=CLAIM_MANY_TRIALS):
+    """claim_many(batch) vs `batch` looped single claims, interleaved.
+
+    A `batch`-slot pool (spares == maximum == batch, so neither arm
+    ever parks or scales), fixed-op trials under the same GC/speed-gate
+    discipline as bench_claim_throughput. The arms STRICTLY alternate
+    — looped, batched, looped, ... — so slow host drift cancels out of
+    the ratio instead of landing on whichever arm ran last; each trial
+    gets a fresh pool. Rates are per HANDLE (batches*batch ops), so
+    the two arms are directly comparable and batched/looped - 1 is the
+    amortization win the bench guard gates at >= 25%."""
+    import gc
+    import statistics
+    build_pool = make_fixture()
+
+    async def fresh_pool():
+        pool = build_pool(spares=batch, maximum=batch)
+        await settle(pool)
+        deadline = asyncio.get_running_loop().time() + 10.0
+        while len(pool.p_idleq) < batch:
+            if asyncio.get_running_loop().time() > deadline:
+                raise RuntimeError('pool never grew to %d idle slots '
+                                   '(%d)' % (batch, len(pool.p_idleq)))
+            await asyncio.sleep(0.005)
+        return pool
+
+    async def stop_pool(pool):
+        pool.stop()
+        while not pool.is_in_state('stopped'):
+            await asyncio.sleep(0.01)
+
+    async def looped_trial(pool):
+        t0 = time.perf_counter()
+        for _ in range(batches):
+            pairs = []
+            for _ in range(batch):
+                pairs.append(await pool.claim({'timeout': 1000}))
+            for hdl, _conn in pairs:
+                hdl.release()
+        return time.perf_counter() - t0
+
+    async def batched_trial(pool):
+        t0 = time.perf_counter()
+        for _ in range(batches):
+            pairs = await pool.claim_many(batch, {'timeout': 1000})
+            pool.release_many([hdl for hdl, _conn in pairs])
+        return time.perf_counter() - t0
+
+    ops = batches * batch
+    arms = {'looped': [], 'batched': []}
+    runner = {'looped': looped_trial, 'batched': batched_trial}
+    frozen = False
+    speed_redos = 0
+    warmup = True
+    while len(arms['batched']) < trials:
+        if not warmup and not frozen:
+            gc.collect()
+            gc.freeze()
+            frozen = True
+        # One looped + one batched measurement per round, back to
+        # back, each on its own pool.
+        round_rates = {}
+        for name in ('looped', 'batched'):
+            pool = await fresh_pool()
+            gc.collect()
+            await speed_gate()
+            gc.disable()
+            elapsed = await runner[name](pool)
+            gc.enable()
+            clean = _speed_ok(_speed_probe())
+            await stop_pool(pool)
+            if not clean and speed_redos < trials * 2:
+                speed_redos += 1
+                round_rates = None   # host degraded: redo the round
+                break
+            round_rates[name] = ops / elapsed
+        if warmup:
+            warmup = False
+            continue
+        if round_rates is None:
+            continue
+        for name, rate in round_rates.items():
+            arms[name].append(rate)
+
+    looped_mean = statistics.mean(arms['looped'])
+    batched_mean = statistics.mean(arms['batched'])
+    return {
+        'batch': batch,
+        'looped_ops_per_sec': round(looped_mean, 1),
+        'looped_stdev': round(statistics.stdev(arms['looped']), 1),
+        'looped_trials': [round(r, 1) for r in arms['looped']],
+        'batched_ops_per_sec': round(batched_mean, 1),
+        'batched_stdev': round(statistics.stdev(arms['batched']), 1),
+        'batched_trials': [round(r, 1) for r in arms['batched']],
+        'batched_vs_looped_pct': round(
+            100.0 * (batched_mean - looped_mean) / looped_mean, 1),
+        'speed_redos': speed_redos,
+        'protocol': ('%d interleaved trial pairs x %d batches x %d '
+                     'handles, looped/batched alternating on fresh '
+                     'pools, gc frozen+disabled in timed sections, '
+                     'speed-gated with degraded rounds redone') % (
+            trials, batches, batch),
+    }
+
+
 # Sharded fleet-router stage: the same saturated-queue protocol as
 # bench_queued_claim_throughput, but one copy per shard, each inside
 # its own event loop. The spawn backend is the scaling arm (thread
@@ -1983,6 +2101,73 @@ def _r(v, nd=1):
     return None if v is None else round(v, nd)
 
 
+# Host-slowdown tripwire: the per-arm throughput columns double as a
+# host-quality canary. A real regression slows the arm whose code
+# changed; a slow CAPTURE HOST slows every arm at once. When every
+# comparable claim arm lands more than this far below the prior
+# committed round, the round carries an explicit host_slowdown_pct
+# diagnostic so the reader (and the next round's author) knows the
+# numbers are suspect before comparing them to history.
+HOST_SLOWDOWN_ARMS = ('claim_release_ops_per_sec',
+                      'claim_queued_ops_per_sec',
+                      'claim_many_ops_per_sec',
+                      'claim_sharded_ops_per_sec')
+HOST_SLOWDOWN_TOL_PCT = 10.0
+
+
+def latest_committed_round(root: str | None = None):
+    """(basename, parsed-result) of the highest committed BENCH_rNN
+    round, or (None, {}) when the tree has none."""
+    import glob
+    import re
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    rounds = [p for p in glob.glob(os.path.join(root, 'BENCH_r*.json'))
+              if re.fullmatch(r'BENCH_r\d+\.json', os.path.basename(p))]
+    if not rounds:
+        return None, {}
+    latest = max(rounds, key=lambda p: int(
+        re.search(r'r(\d+)', os.path.basename(p)).group(1)))
+    try:
+        with open(latest, encoding='utf-8') as f:
+            parsed = json.load(f).get('parsed') or {}
+    except (OSError, ValueError):
+        return None, {}
+    return os.path.basename(latest), parsed
+
+
+def compute_host_slowdown(result: dict, prior: dict,
+                          prior_name: str | None = None):
+    """The host-slowdown diagnostic, or None when the round is fine.
+
+    Fires only when EVERY arm measured in both rounds is more than
+    HOST_SLOWDOWN_TOL_PCT below the prior committed value: one slow
+    arm is a regression in that arm's code and must NOT be masked as
+    host noise, but all of them moving together is the capture host
+    (cgroup cap, noisy neighbor, thermal clamp). host_slowdown_pct is
+    the MINIMUM drop across arms — 'every arm ran at least this much
+    slow' — the conservative figure to de-rate comparisons by."""
+    arms = {}
+    for key in HOST_SLOWDOWN_ARMS:
+        cur, prev = result.get(key), prior.get(key)
+        if isinstance(cur, (int, float)) and \
+                isinstance(prev, (int, float)) and prev > 0:
+            arms[key] = round(100.0 * (prev - cur) / prev, 1)
+    if not arms:
+        return None
+    if any(drop <= HOST_SLOWDOWN_TOL_PCT for drop in arms.values()):
+        return None
+    return {
+        'host_slowdown_pct': min(arms.values()),
+        'arms': arms,
+        'vs_round': prior_name,
+        'note': ('every claim arm ran >%.0f%% below %s: the capture '
+                 'host was slow, not the code — treat cross-round '
+                 'comparisons of this round with suspicion' % (
+                     HOST_SLOWDOWN_TOL_PCT, prior_name or
+                     'the prior committed round')),
+    }
+
+
 def artifact_citation(root: str | None = None) -> dict:
     """When a run can't reach the chip, point at the committed chip
     artifact — but ONLY if its recorded code hash still matches the
@@ -2034,7 +2219,8 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
                     actuation_ab=None, attribution_ab=None,
                     health=None, profile_ab=None,
                     profile_attribution=None,
-                    profile_flamegraph=None) -> dict:
+                    profile_flamegraph=None,
+                    claim_many=None) -> dict:
     """Build the single JSON-line result from the stage outputs.
 
     Factored out of main() so the guard tests can assert the
@@ -2158,6 +2344,17 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
             health.get('health_step_pools_per_sec')
         result['health_step_us'] = health.get('health_step_us')
         result['health_step_backend'] = health.get('backend')
+    if claim_many is not None:
+        # Headline batched rate plus its looped twin: the ratio is
+        # what the bench guard gates (>= 1.25x at batch=64).
+        result['claim_many_ops_per_sec'] = \
+            claim_many['batched_ops_per_sec']
+        result['claim_many_looped_ops_per_sec'] = \
+            claim_many['looped_ops_per_sec']
+        result['claim_many_batch'] = claim_many['batch']
+        result['claim_many_vs_looped_pct'] = \
+            claim_many['batched_vs_looped_pct']
+        result['claim_many_ab'] = claim_many
     if tracing_ab is not None:
         result['claim_tracing_ab'] = tracing_ab
     if pump_ab is not None:
@@ -2300,6 +2497,7 @@ async def main(host_only: bool = False, sharded_only: bool = False,
     abs_err = await bench_codel_tracking()
     claim = await bench_claim_throughput()
     queued = await bench_queued_claim_throughput()
+    claim_many = await bench_claim_many()
     sharded = await bench_sharded_claims_guarded()
     tracing_ab = await bench_tracing_ab()
     pump_ab = await bench_pump_ab()
@@ -2327,7 +2525,15 @@ async def main(host_only: bool = False, sharded_only: bool = False,
                              attribution_ab=attribution_ab,
                              health=health, profile_ab=profile_ab,
                              profile_attribution=profile_attribution,
-                             profile_flamegraph=profile_flamegraph)
+                             profile_flamegraph=profile_flamegraph,
+                             claim_many=claim_many)
+    # Host-quality canary: when every claim arm runs >10% below the
+    # prior committed round, say so IN the round record.
+    prior_name, prior = latest_committed_round()
+    slowdown = compute_host_slowdown(result, prior, prior_name)
+    if slowdown is not None:
+        result['host_slowdown_pct'] = slowdown['host_slowdown_pct']
+        result['host_slowdown'] = slowdown
     if host_only:
         result['host_only'] = True
     print(json.dumps(result))

@@ -69,6 +69,14 @@ from .debug import (
     install_debug_handler,
     init_from_env as _debug_init_from_env,
 )
+from .transport import (
+    Transport,
+    AsyncioTransport,
+    FabricTransport,
+    NativeTransport,
+    get_transport,
+    register_transport,
+)
 
 __version__ = '1.0.0'
 
@@ -95,6 +103,8 @@ __all__ = [
     'dump_fsm_histories', 'install_debug_handler',
     'enable_tracing', 'disable_tracing', 'tracing_enabled',
     'trace_ring',
+    'Transport', 'AsyncioTransport', 'FabricTransport',
+    'NativeTransport', 'get_transport', 'register_transport',
     'EventEmitter', 'FSM', 'Queue', 'ControlledDelay',
     'enable_stack_traces', 'stack_traces_enabled', 'current_millis',
     'plan_rebalance',

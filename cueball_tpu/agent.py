@@ -27,11 +27,14 @@ import asyncio
 import logging
 import ssl as mod_ssl
 
+from . import transport as mod_transport
 from . import utils as mod_utils
 from .events import EventEmitter
 from .fsm import get_loop
 from .pool import ConnectionPool
 from .resolver import pool_resolver
+# Back-compat alias: agent grew this protocol before the seam did.
+from .transport import WatchedStreamProtocol as _WatchedProtocol
 
 # TLS fields passed through from agent options to the socket constructor
 # (reference lib/agent.js:96-97).
@@ -39,37 +42,19 @@ PASS_FIELDS = ['certfile', 'keyfile', 'ca', 'ciphers', 'servername',
                'rejectUnauthorized']
 
 
-class _WatchedProtocol(asyncio.StreamReaderProtocol):
-    """StreamReaderProtocol that reports connection loss to the owning
-    HttpSocket even while the connection sits idle in the pool. Node's
-    net.Socket emits 'close' on FIN regardless of reads; plain asyncio
-    streams only surface EOF at the next read, which would leave dead
-    idle connections undetected until claimed."""
-
-    def __init__(self, reader, owner, loop):
-        super().__init__(reader, loop=loop)
-        self._owner = owner
-
-    def eof_received(self):
-        super().eof_received()
-        # Close on FIN rather than lingering half-open (node's
-        # allowHalfOpen=false default) so connection_lost fires and the
-        # pool learns the backend hung up.
-        return False
-
-    def connection_lost(self, exc):
-        super().connection_lost(exc)
-        self._owner._on_connection_lost(exc)
-
-
 class HttpSocket(EventEmitter):
-    """Connection-interface object over an asyncio TCP/TLS stream
-    (the constructSocket analogue, reference lib/agent.js:146-197)."""
+    """Connection-interface object over a transport TCP/TLS stream
+    (the constructSocket analogue, reference lib/agent.js:146-197).
+    All raw socket work — opening the stream, keep-alive sockopts —
+    goes through the Transport seam; this class owns only the HTTP
+    agent's connection contract (events, destroy, reader/writer)."""
 
     def __init__(self, backend: dict, tls: dict | None = None,
-                 tcp_keepalive_delay: float | None = None):
+                 tcp_keepalive_delay: float | None = None,
+                 transport: mod_transport.Transport | None = None):
         super().__init__()
         self.backend = backend
+        self.transport = mod_transport.get_transport(transport)
         self.reader: asyncio.StreamReader | None = None
         self.writer: asyncio.StreamWriter | None = None
         self.local_port: int | None = None
@@ -103,33 +88,23 @@ class HttpSocket(EventEmitter):
     async def _connect(self):
         try:
             loop = asyncio.get_running_loop()
-            kwargs = {}
+            ssl_ctx = None
+            server_hostname = None
             if self.tls is not None:
-                kwargs['ssl'] = self._ssl_context()
+                ssl_ctx = self._ssl_context()
                 # SNI servername override (reference lib/agent.js:158).
-                kwargs['server_hostname'] = self.tls.get('servername') or \
+                server_hostname = self.tls.get('servername') or \
                     self.backend.get('name') or self.backend['address']
             reader = asyncio.StreamReader(loop=loop)
-            transport, protocol = await loop.create_connection(
+            stream, protocol = await self.transport.create_stream(
                 lambda: _WatchedProtocol(reader, self, loop),
-                self.backend['address'], self.backend['port'], **kwargs)
+                self.backend['address'], self.backend['port'],
+                ssl=ssl_ctx, server_hostname=server_hostname)
             self.reader = reader
             self.writer = asyncio.StreamWriter(
-                transport, protocol, reader, loop)
-            sock = transport.get_extra_info('socket')
-            if sock is not None:
-                import socket as mod_socket
-                self.local_port = sock.getsockname()[1]
-                # Keep-alive is always on (reference lib/agent.js:52,
-                # 188-191); the optional delay maps to TCP_KEEPIDLE.
-                sock.setsockopt(mod_socket.SOL_SOCKET,
-                                mod_socket.SO_KEEPALIVE, 1)
-                if self.tcp_keepalive_delay is not None and \
-                        hasattr(mod_socket, 'TCP_KEEPIDLE'):
-                    sock.setsockopt(
-                        mod_socket.IPPROTO_TCP,
-                        mod_socket.TCP_KEEPIDLE,
-                        max(1, int(self.tcp_keepalive_delay / 1000)))
+                stream, protocol, reader, loop)
+            self.local_port = self.transport.configure_keepalive(
+                stream, delay_ms=self.tcp_keepalive_delay)
             self.emit('connect')
         except (OSError, mod_ssl.SSLError) as e:
             self.emit('error', e)
@@ -257,6 +232,8 @@ class CueBallAgent(EventEmitter):
         self.cba_upgraded: set = set()
 
         self.tcp_ka_delay = options.get('tcpKeepAliveInitialDelay')
+        self.cba_transport = mod_transport.get_transport(
+            options.get('transport'))
         self.pools: dict[str, ConnectionPool] = {}
         self.pool_resolvers: dict[str, object] = {}
         self.resolvers = options.get('resolvers')
@@ -287,7 +264,8 @@ class CueBallAgent(EventEmitter):
 
         def construct(backend):
             return HttpSocket(backend, tls=tls,
-                              tcp_keepalive_delay=self.tcp_ka_delay)
+                              tcp_keepalive_delay=self.tcp_ka_delay,
+                              transport=self.cba_transport)
         return construct
 
     def _add_pool(self, host: str, options: dict) -> ConnectionPool:

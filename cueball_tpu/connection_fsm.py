@@ -867,6 +867,22 @@ def obtain_claim_handle(options: dict) -> CueBallClaimHandle:
     return CueBallClaimHandle(options)
 
 
+def arm_claim_timers(handles) -> None:
+    """Batched arm_claim_timer for claim_many's park path. A batch
+    shares one claimTimeout and its handles were minted in the same
+    loop tick, so their deadlines land in (at most one quantum of)
+    the same wheel bucket: resolve the bucket once via wheel_arm_many
+    instead of per handle. The shared deadline is the LATEST in the
+    batch — the wheel may fire a claim a few ms late (inside its
+    normal quantum slop), never early."""
+    arm = [h for h in handles if callable(h._ch_arm_timer)]
+    if not arm:
+        return
+    deadline = max(h.ch_started for h in arm) + arm[0].ch_claim_timeout
+    for h, tok in zip(arm, mod_runq.wheel_arm_many(deadline, arm)):
+        h._ch_arm_timer = tok
+
+
 # ---------------------------------------------------------------------------
 # ConnectionSlotFSM
 
@@ -1121,7 +1137,32 @@ class ConnectionSlotFSM(FSM):
         # (reference lib/connection-fsm.js:1183-1196).
         if smgr.is_in_state('connected'):
             sock = smgr.get_socket()
-            hdl.accept(sock)
+            probe = getattr(sock, 'cb_claim_ready', None)
+            if probe is None:
+                hdl.accept(sock)
+            else:
+                # Transport-level claim-readiness probe: a transport
+                # that must move bytes before the connection is usable
+                # for THIS claim (e.g. netsim trickling TCP segments
+                # mid-handshake) exposes cb_claim_ready(done); accept
+                # is deferred until done(ok). The handle sits in
+                # 'claiming' throughout, so probe time lands in the
+                # ledger's handshake phase, not queue_wait. A probe
+                # that completes synchronously is byte-identical to
+                # the plain accept path. Transports MUST eventually
+                # call done — a probed claim cannot time out.
+                def on_ready(ok):
+                    if self.csf_handle is not hdl or \
+                            not hdl.is_in_state('claiming') or \
+                            not self.is_in_state('busy'):
+                        return
+                    if ok and state['smgr'] == 'connected':
+                        hdl.accept(sock)
+                    else:
+                        hdl.reject()
+                        self.csf_handle = None
+                        on_release()
+                probe(on_ready)
         else:
             hdl.reject()
             self.csf_handle = None

@@ -42,6 +42,15 @@ class ConnectionSet(FSM):
         if not isinstance(options, dict):
             raise AssertionError('options must be a dict')
         constructor = options.get('constructor')
+        # Same transport seam as ConnectionPool: options['transport']
+        # supplies the constructor when none is passed explicitly.
+        self.cs_transport = None
+        if options.get('transport') is not None:
+            from . import transport as mod_transport
+            self.cs_transport = mod_transport.get_transport(
+                options['transport'])
+            if constructor is None:
+                constructor = self.cs_transport.connector
         if not callable(constructor):
             raise AssertionError('options.constructor must be callable')
 

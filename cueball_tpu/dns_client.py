@@ -235,54 +235,81 @@ def parse_response(data: bytes) -> DnsMessage:
 
 
 # ---------------------------------------------------------------------------
+# Sans-io query core
+
+class DnsQueryCore:
+    """The pure per-resolver query state machine, no loop and no
+    sockets: callers move the bytes, the core decides what they mean.
+
+    Protocol::
+
+        core = DnsQueryCore(domain, qtype)
+        verb, payload = core.begin()          # ('udp', query bytes)
+        while verb != 'done':
+            data = <exchange payload via verb>
+            verb, payload = core.on_response(data)
+        msg = payload                         # parsed DnsMessage
+
+    Decisions encoded (formerly inlined in ``_query_wire``):
+
+    - FORMERR/NOTIMP on the FIRST (EDNS) response only -> retry once
+      as a plain RFC 1035 query with a fresh qid (RFC 6891 6.2.2). A
+      genuine FORMERR on the plain retry propagates as DnsError.
+    - TC bit on either UDP response -> replay the current payload over
+      TCP.
+    - Any other non-NOERROR rcode -> DnsError.
+    - Malformed bytes -> struct.error/ValueError propagate from
+      ``parse_response``; timeout policy belongs to the driver.
+    """
+
+    def __init__(self, domain: str, qtype: str, rng=None,
+                 resolver: str | None = None):
+        self.domain = domain
+        self.qtype = qtype
+        self.resolver = resolver
+        self._rng = rng if rng is not None else mod_utils.get_rng()
+        # States: 'udp-edns' (first try, OPT attached) -> 'udp-plain'
+        # (EDNS fallback) -> 'tcp' (truncation replay). The fallback
+        # edge only exists from 'udp-edns'.
+        self._state = 'udp-edns'
+        self._payload = build_query(
+            self._rng.randrange(65536), domain, qtype)
+
+    def begin(self) -> tuple:
+        return ('udp', self._payload)
+
+    def on_response(self, data: bytes) -> tuple:
+        msg = parse_response(data)
+        if self._state == 'udp-edns' and \
+                msg.rcode in ('FORMERR', 'NOTIMP'):
+            self._state = 'udp-plain'
+            self._payload = build_query(
+                self._rng.randrange(65536), self.domain, self.qtype,
+                edns_size=None)
+            return ('udp', self._payload)
+        if self._state != 'tcp' and msg.tc:
+            self._state = 'tcp'
+            return ('tcp', self._payload)
+        if msg.rcode != 'NOERROR':
+            raise DnsError(msg.rcode, self.domain, self.resolver)
+        return ('done', msg)
+
+
+# ---------------------------------------------------------------------------
 # Transport
-
-class _UdpQuery(asyncio.DatagramProtocol):
-    def __init__(self, fut: asyncio.Future, qid: int):
-        self.fut = fut
-        self.qid = qid
-
-    def datagram_received(self, data, addr):
-        # Drop datagrams whose transaction ID doesn't match the query:
-        # qid randomization is the anti-spoofing entropy and is useless
-        # unless checked on receive.
-        if len(data) < 2 or \
-                struct.unpack('>H', data[:2])[0] != self.qid:
-            return
-        if not self.fut.done():
-            self.fut.set_result(data)
-
-    def error_received(self, exc):
-        if not self.fut.done():
-            self.fut.set_exception(exc)
-
 
 async def query_udp(resolver: str, port: int, payload: bytes,
                     timeout_s: float) -> bytes:
-    loop = asyncio.get_running_loop()
-    fut = loop.create_future()
-    qid = struct.unpack('>H', payload[:2])[0]
-    transport, _ = await loop.create_datagram_endpoint(
-        lambda: _UdpQuery(fut, qid), remote_addr=(resolver, port))
-    try:
-        transport.sendto(payload)
-        return await asyncio.wait_for(fut, timeout_s)
-    finally:
-        transport.close()
+    from . import transport as mod_transport
+    return await mod_transport.get_transport().dns_udp(
+        resolver, port, payload, timeout_s)
 
 
 async def query_tcp(resolver: str, port: int, payload: bytes,
                     timeout_s: float) -> bytes:
-    reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(resolver, port), timeout_s)
-    try:
-        writer.write(struct.pack('>H', len(payload)) + payload)
-        await writer.drain()
-        ln = struct.unpack('>H', await asyncio.wait_for(
-            reader.readexactly(2), timeout_s))[0]
-        return await asyncio.wait_for(reader.readexactly(ln), timeout_s)
-    finally:
-        writer.close()
+    from . import transport as mod_transport
+    return await mod_transport.get_transport().dns_tcp(
+        resolver, port, payload, timeout_s)
 
 
 class DnsTransport:
@@ -343,8 +370,7 @@ class DnsClient:
                           timeout_s: float) -> DnsMessage:
         host, _, portstr = resolver.partition('@')
         port = int(portstr) if portstr else 53
-        qid = mod_utils.get_rng().randrange(65536)
-        payload = build_query(qid, domain, qtype)
+        core = DnsQueryCore(domain, qtype, resolver=resolver)
         # One DEADLINE for this resolver's whole attempt: the EDNS
         # fallback and the TC->TCP retry each consume what remains,
         # never a fresh slice — otherwise one resolver could stretch
@@ -356,24 +382,16 @@ class DnsClient:
 
         def left() -> float:
             return max(deadline - clk.monotonic(), 0.001)
+        verb, payload = core.begin()
         try:
-            data = await self.transport.udp(host, port, payload, left())
-            msg = parse_response(data)
-            if msg.rcode in ('FORMERR', 'NOTIMP'):
-                # Legacy server/middlebox rejecting the OPT record:
-                # retry once as a plain RFC 1035 query
-                # (RFC 6891 6.2.2). A genuine FORMERR/NOTIMP just
-                # comes back again and propagates below.
-                qid = mod_utils.get_rng().randrange(65536)
-                payload = build_query(qid, domain, qtype,
-                                      edns_size=None)
-                data = await self.transport.udp(host, port, payload,
-                                                left())
-                msg = parse_response(data)
-            if msg.tc:
-                data = await self.transport.tcp(host, port, payload,
-                                                left())
-                msg = parse_response(data)
+            while verb != 'done':
+                if verb == 'udp':
+                    data = await self.transport.udp(host, port,
+                                                    payload, left())
+                else:
+                    data = await self.transport.tcp(host, port,
+                                                    payload, left())
+                verb, payload = core.on_response(data)
         except (asyncio.TimeoutError, TimeoutError):
             raise DnsTimeoutError(domain, resolver)
         except struct.error as e:
@@ -381,9 +399,7 @@ class DnsClient:
             # letting it kill the lookup task.
             raise ValueError('malformed DNS response from %s: %s' % (
                 resolver, e))
-        if msg.rcode != 'NOERROR':
-            raise DnsError(msg.rcode, domain, resolver)
-        return msg
+        return payload
 
     async def _lookup(self, opts: dict, cb) -> None:
         domain = opts['domain']

@@ -34,7 +34,9 @@ from __future__ import annotations
 import logging
 import math
 import os
-import socket
+# Interface enumeration (not byte movement): getifaddrs-style
+# probing has no Transport verb, so the raw import stays licensed.
+import socket  # cblint: ignore=C110
 
 from . import dns_client as mod_nsc
 from . import trace as mod_trace

@@ -201,14 +201,62 @@ def _route(method: str, path: str, collector):
                 mod_trace.export_ndjson(), limit, backend).encode()
             ctype = 'application/x-ndjson'
         elif path == '/kang/health':
-            body = json.dumps(_health_payload(),
+            # ?limit=N keeps the newest N monitor rows (the fleet
+            # merge always covers all of them). Malformed params are
+            # 400s with JSON bodies, same convention as /kang/traces.
+            params = urllib.parse.parse_qs(query,
+                                           keep_blank_values=True)
+            unknown = sorted(set(params) - {'limit'})
+            if unknown:
+                return (400, ctype, json.dumps(
+                    {'error': 'unknown parameter(s) %s; supported: '
+                              'limit' % ', '.join(unknown)}).encode())
+            payload = _health_payload()
+            if 'limit' in params:
+                try:
+                    limit = int(params['limit'][-1])
+                except ValueError:
+                    return (400, ctype, json.dumps(
+                        {'error': 'limit must be an integer, got %r'
+                                  % params['limit'][-1]}).encode())
+                if limit < 0:
+                    return (400, ctype, json.dumps(
+                        {'error': 'limit must be >= 0, got %d'
+                                  % limit}).encode())
+                payload = dict(payload,
+                               monitors=payload['monitors'][-limit:]
+                               if limit else [])
+            body = json.dumps(payload,
                               default=_json_default).encode()
         elif path == '/kang/profile':
             # Collapsed-stack flamegraph text: one "frame;frame N"
             # line per ledger phase and sampler bucket; feed to any
             # flamegraph renderer. Empty when nothing was profiled.
+            # ?phase=<name> keeps only that ledger phase's stacks;
+            # malformed params are 400 JSON, per the /kang/traces
+            # convention.
             from . import profile as mod_profile
-            body = mod_profile.flamegraph().encode()
+            params = urllib.parse.parse_qs(query,
+                                           keep_blank_values=True)
+            unknown = sorted(set(params) - {'phase'})
+            if unknown:
+                return (400, ctype, json.dumps(
+                    {'error': 'unknown parameter(s) %s; supported: '
+                              'phase' % ', '.join(unknown)}).encode())
+            phase = None
+            if 'phase' in params:
+                phase = params['phase'][-1]
+                if phase not in mod_profile.PHASES:
+                    return (400, ctype, json.dumps(
+                        {'error': 'unknown phase %r; one of %s' % (
+                            phase, ', '.join(mod_profile.PHASES))}
+                    ).encode())
+            text = mod_profile.flamegraph()
+            if phase is not None:
+                kept = [ln for ln in text.splitlines()
+                        if ln.split(' ')[0].split(';')[1] == phase]
+                text = '\n'.join(kept) + '\n' if kept else ''
+            body = text.encode()
             ctype = 'text/plain; charset=utf-8'
         elif path == '/metrics' and collector is not None:
             body = collector.collect().encode()
@@ -249,9 +297,11 @@ async def _serve_client(reader, writer, collector=None):
 
 
 async def serve_monitor(port: int = 0, host: str = '127.0.0.1',
-                        collector=None):
+                        collector=None, transport=None):
     """Start the kang endpoint; returns the asyncio server (its bound
-    port via server.sockets[0].getsockname()[1])."""
-    return await asyncio.start_server(
+    port via server.sockets[0].getsockname()[1]). The listening socket
+    comes from the Transport seam (default AsyncioTransport)."""
+    from . import transport as mod_transport
+    return await mod_transport.get_transport(transport).serve(
         lambda r, w: _serve_client(r, w, collector=collector),
         host, port)

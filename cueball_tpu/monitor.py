@@ -11,7 +11,7 @@ over HTTP (GET /kang/snapshot).
 
 from __future__ import annotations
 
-import socket as mod_socket
+from .transport import host_ident as _host_ident
 
 
 class PoolMonitor:
@@ -206,7 +206,7 @@ class PoolMonitor:
             'uri_base': '/kang',
             'service_name': 'cueball',
             'version': '1.0.0',
-            'ident': mod_socket.gethostname(),
+            'ident': _host_ident(),
             'list_types': self.list_types,
             'list_objects': self.list_objects,
             'get': self.get,
@@ -219,7 +219,7 @@ class PoolMonitor:
         """Full JSON-able snapshot of every registered object (what the
         kang HTTP endpoint serves)."""
         out: dict = {'service_name': 'cueball',
-                     'ident': mod_socket.gethostname(),
+                     'ident': _host_ident(),
                      'types': {}}
         for t in self.list_types():
             out['types'][t] = {

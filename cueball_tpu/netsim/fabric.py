@@ -43,7 +43,8 @@ class LinkModel:
     def __init__(self, latency_ms: float = 1.0, jitter_ms: float = 0.0,
                  loss: float = 0.0, connect: str = 'ok',
                  slow_s: float = 300.0, service_ms: float = 1.0,
-                 service_mult: float = 1.0):
+                 service_mult: float = 1.0, trickle_segments: int = 0,
+                 trickle_ms: float = 5.0):
         self.latency_ms = latency_ms
         self.jitter_ms = jitter_ms
         self.loss = loss
@@ -51,6 +52,13 @@ class LinkModel:
         self.slow_s = slow_s
         self.service_ms = service_ms
         self.service_mult = service_mult
+        # Claim-handshake trickle: the peer dribbles the claim-time
+        # handshake out in `trickle_segments` segments of `trickle_ms`
+        # each (SimConnection.cb_claim_ready), modeling a middlebox
+        # that fragments and delays segments mid-handshake without
+        # failing the connection.
+        self.trickle_segments = trickle_segments
+        self.trickle_ms = trickle_ms
 
     def delay_s(self, rng) -> float:
         d = self.latency_ms
@@ -86,6 +94,20 @@ class SimConnection(EventEmitter):
         self.dead = False
         self.refd = True
         self._timer = None
+        # The claim-readiness probe is bound as an INSTANCE attribute,
+        # and only when this connection's link actually trickles: the
+        # slot FSM probes via getattr on every single claim, so a
+        # class-level method would tax the hot path of every netsim
+        # soak (~14us/claim) for a fault mode almost no run uses.
+        # Consequence: trickle config must be in place before the
+        # connection is created — links mutated afterwards affect
+        # only connections made from then on, like every other
+        # connect-time link property.
+        lm = fabric._links.get(self.key)
+        if lm is None and self.akey is not None:
+            lm = fabric._links.get(self.akey)
+        if lm is not None and lm.trickle_segments:
+            self.cb_claim_ready = self._cb_claim_ready
         fabric._register(self)
         self._schedule_handshake()
 
@@ -143,6 +165,38 @@ class SimConnection(EventEmitter):
             self._timer.cancel()
         self.fabric._unregister(self)
         self.emit('close')
+
+    # -- claim-readiness probe --------------------------------------------
+
+    def _cb_claim_ready(self, done) -> None:
+        """Transport claim-readiness probe (connection_fsm state_busy
+        seam), bound to ``cb_claim_ready`` at construction when the
+        link trickles. With ``trickle_segments`` configured, the
+        claim-time handshake dribbles out in N virtual segments of
+        ``trickle_ms`` each before completing — the middlebox that
+        fragments and delays segments mid-handshake without failing
+        the connection. Without trickle, ``done(True)`` fires
+        synchronously, byte-identical to the plain accept path."""
+        if self.dead or not self.connected:
+            done(False)
+            return
+        link = self.fabric.link_for(self)
+        segments = int(link.trickle_segments or 0)
+        if segments <= 0:
+            done(True)
+            return
+
+        def step(k):
+            if self.dead or not self.connected:
+                done(False)
+                return
+            if k >= segments:
+                done(True)
+                return
+            self._timer = get_loop().call_later(
+                link.trickle_ms / 1000.0, step, k + 1)
+
+        step(0)
 
     # -- application work ------------------------------------------------
 

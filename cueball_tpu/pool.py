@@ -32,7 +32,8 @@ from . import codel as mod_codel
 from . import errors as mod_errors
 from . import trace as mod_trace
 from . import utils as mod_utils
-from .connection_fsm import ConnectionSlotFSM, obtain_claim_handle
+from .connection_fsm import (ConnectionSlotFSM, arm_claim_timers,
+                             obtain_claim_handle)
 from .cqueue import Queue
 from .events import EventEmitter
 from .fsm import FSM, get_loop
@@ -154,6 +155,18 @@ class ConnectionPool(FSM):
         if not isinstance(options, dict):
             raise AssertionError('options must be a dict')
         constructor = options.get('constructor')
+        # The transport seam: options['transport'] (a Transport
+        # instance, a registry name, or None) supplies the connection
+        # constructor when the caller doesn't pass one explicitly; an
+        # explicit constructor always wins (it IS a transport
+        # decision the caller already made).
+        self.p_transport = None
+        if options.get('transport') is not None:
+            from . import transport as mod_transport
+            self.p_transport = mod_transport.get_transport(
+                options['transport'])
+            if constructor is None:
+                constructor = self.p_transport.connector
         if not callable(constructor):
             raise AssertionError('options.constructor must be callable')
 
@@ -451,10 +464,10 @@ class ConnectionPool(FSM):
                 return val
         return self.p_lpf.get()
 
-    def _incr_counter(self, counter: str) -> None:
+    def _incr_counter(self, counter: str, n: int = 1) -> None:
         mod_utils.update_error_metrics(
             self.p_collector, self.p_uuid, counter)
-        self.p_counters[counter] = self.p_counters.get(counter, 0) + 1
+        self.p_counters[counter] = self.p_counters.get(counter, 0) + n
 
     _incrCounter = _incr_counter
 
@@ -1261,6 +1274,197 @@ class ConnectionPool(FSM):
         except asyncio.CancelledError:
             waiter.cancel()
             raise
+
+    # -- batched claim ---------------------------------------------------
+
+    def _claim_retry(self, handle, err_on_empty) -> None:
+        """Single-handle requeue for claim_many handles (the exact
+        try_next body of claim_cb): runs when a rejected handshake
+        re-enters 'waiting'. Re-entries are rare, so the park
+        bookkeeping here is per-handle, not batched."""
+        if not handle.is_in_state('waiting'):
+            return
+        while len(self.p_idleq) > 0:
+            fsm = self.p_idleq.shift()
+            fsm.p_idleq_node = None
+            if not fsm.is_in_state('idle'):
+                continue
+            self._telemetry_dirty()
+            if handle.ch_trace is not None:
+                handle.ch_trace.slot_selected('idleq')
+            handle.try_(fsm)
+            return
+        if err_on_empty and self.p_resolver.count() < 1:
+            handle.fail(mod_errors.NoBackendsError(
+                self, self.p_resolver.get_last_error()))
+            return
+        handle.ch_waiter_node = self.p_waiters.push(handle)
+        self._telemetry_dirty()
+        handle.arm_claim_timer()
+        self._hwm_counter('max-claim-queue', len(self.p_waiters))
+        self._incr_counter('queued-claim')
+        self._arm_codel_pacer()
+        self.rebalance()
+
+    def claim_many_cb(self, n: int, options=None, cb=None):
+        """Batched callback claim: mint ``n`` claims with the
+        per-claim bookkeeping paid once per batch — one option/timeout
+        parse, one pool-state check, one stack capture, one deferred
+        dispatch hop, and (for the parked remainder) one telemetry
+        flag, one queued-claim counter bump, one pacer nudge, one
+        rebalance and one timer-wheel bucket resolution. ``cb`` fires
+        once per claim with the single-claim (err) / (None, handle,
+        connection) signature; claims that find no idle slot park in
+        the wait queue exactly like single claims (FIFO order
+        preserved within the batch). Returns the list of ClaimHandles
+        (or cancel-shims when the pool is stopping/failed)."""
+        if callable(options) and cb is None:
+            cb = options
+            options = {}
+        options = options or {}
+        if not callable(cb):
+            raise AssertionError('cb must be callable')
+        if not isinstance(n, int) or n < 0:
+            raise AssertionError('n must be a non-negative integer')
+        err_on_empty = options.get('errorOnEmpty')
+
+        if self.p_codel is not None:
+            if isinstance(options.get('timeout'), (int, float)):
+                raise RuntimeError('options.timeout not allowed when '
+                                   'targetClaimDelay has been set')
+            timeout = self.p_codel.get_max_idle()
+        elif isinstance(options.get('timeout'), (int, float)):
+            timeout = options['timeout']
+        else:
+            timeout = math.inf
+
+        self._incr_counter('claim', n)
+
+        if self.is_in_state('stopping') or self.is_in_state('stopped') \
+                or self.is_in_state('failed'):
+            failed = self.is_in_state('failed')
+            states = [{'done': False} for _ in range(n)]
+
+            def fail_all():
+                for st in states:
+                    if not st['done']:
+                        cb(mod_errors.PoolFailedError(
+                            self, self.p_last_error) if failed
+                           else mod_errors.PoolStoppingError(self))
+                    st['done'] = True
+            defer(fail_all)
+            return [_CancelShim(st) for st in states]
+
+        e = mod_utils.maybe_capture_stack_trace()
+        tracer = mod_trace._runtime
+        handles = []
+        for _ in range(n):
+            handle = obtain_claim_handle({
+                'pool': self,
+                'claimStack': e['stack'],
+                'callback': cb,
+                'log': self.p_log,
+                'claimTimeout': timeout,
+            })
+            if tracer is not None:
+                tracer.claim_begin(handle, self)
+            # Rejection re-entries keep single-claim semantics via the
+            # per-handle retry; only the initial dispatch is batched.
+            handle.ch_requeue = \
+                lambda h=handle: self._claim_retry(h, err_on_empty)
+            handles.append(handle)
+
+        def dispatch():
+            parked = []
+            touched_idle = False
+            for handle in handles:
+                if not handle.is_in_state('waiting'):
+                    continue
+                slot = None
+                # Stale idleq entries: same rip-and-move-on as
+                # claim_cb's try_next (reference lib/pool.js:929-951).
+                while len(self.p_idleq) > 0:
+                    fsm = self.p_idleq.shift()
+                    fsm.p_idleq_node = None
+                    if fsm.is_in_state('idle'):
+                        slot = fsm
+                        break
+                if slot is not None:
+                    touched_idle = True
+                    if handle.ch_trace is not None:
+                        handle.ch_trace.slot_selected('idleq')
+                    handle.try_(slot)
+                    continue
+                if err_on_empty and self.p_resolver.count() < 1:
+                    handle.fail(mod_errors.NoBackendsError(
+                        self, self.p_resolver.get_last_error()))
+                    continue
+                parked.append(handle)
+            if touched_idle:
+                # Idleq shifts moved the busy count NOW; one flag
+                # covers the whole batch.
+                self._telemetry_dirty()
+            if parked:
+                for handle in parked:
+                    handle.ch_waiter_node = self.p_waiters.push(handle)
+                arm_claim_timers(parked)
+                self._telemetry_dirty()
+                self._hwm_counter('max-claim-queue',
+                                  len(self.p_waiters))
+                self._incr_counter('queued-claim', len(parked))
+                self._arm_codel_pacer()
+                self.rebalance()
+
+        defer(dispatch)
+        return handles
+
+    async def claim_many(self, n: int, options: dict | None = None):
+        """Asyncio-native batched claim: returns a list of ``n``
+        (handle, connection) pairs once every claim in the batch has
+        resolved. If any claim fails, the batch's successful claims
+        are released and the first error raised (all-or-nothing, so a
+        partial batch can't leak leases). Cancelling the awaiting
+        task cancels unresolved claims and releases resolved ones."""
+        if n == 0:
+            return []
+        loop = get_loop()
+        fut: asyncio.Future = loop.create_future()
+        results: list = []
+        state = {'pending': n, 'err': None}
+
+        def cb(err, hdl=None, conn=None):
+            if fut.cancelled():
+                if hdl is not None:
+                    hdl.release()
+                return
+            if err is not None:
+                if state['err'] is None:
+                    state['err'] = err
+            else:
+                results.append((hdl, conn))
+            state['pending'] -= 1
+            if state['pending'] == 0:
+                if state['err'] is not None:
+                    for pair in results:
+                        pair[0].release()
+                    fut.set_exception(state['err'])
+                else:
+                    fut.set_result(results)
+
+        waiters = self.claim_many_cb(n, options, cb)
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            for w in waiters:
+                w.cancel()
+            raise
+
+    def release_many(self, handles) -> None:
+        """Release a batch of claimed handles. Each release's slot
+        events defer through the runq pump, so the whole batch drains
+        in one pump tick instead of one loop turn apiece."""
+        for h in handles:
+            h.release()
 
 
 class _CancelShim:

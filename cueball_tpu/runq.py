@@ -51,7 +51,7 @@ from . import utils as mod_utils
 from .events import _native
 
 __all__ = ['defer', 'pump_enabled', 'set_pump_enabled', 'pump_depth',
-           'wheel_arm', 'wheel_cancel', 'wheel_depth',
+           'wheel_arm', 'wheel_arm_many', 'wheel_cancel', 'wheel_depth',
            'WHEEL_QUANTUM_MS']
 
 # Bound to cueball_tpu.profile while its sampler runs, so SIGPROF
@@ -189,6 +189,36 @@ def wheel_arm(deadline_ms, handle):
                         _wheel_fire, loop, bucket)
     slot[token] = handle
     return token
+
+
+def wheel_arm_many(deadline_ms, handles):
+    """Batched wheel_arm for handles sharing one deadline (the
+    claim_many park path): the loop lookup, bucket computation and
+    timer-exists check are paid once for the whole batch, then each
+    handle is one dict insert. Returns one token per handle, in
+    order."""
+    global _wheel_tok
+    loop = asyncio.get_running_loop()
+    bucket = int(deadline_ms // WHEEL_QUANTUM_MS) + 1
+    buckets = _wheel.get(loop)
+    if buckets is None:
+        if _wheel:
+            for stale in [ln for ln in _wheel if ln.is_closed()]:
+                del _wheel[stale]
+        buckets = _wheel[loop] = {}
+    slot = buckets.get(bucket)
+    if slot is None:
+        slot = buckets[bucket] = {}
+        delay_ms = bucket * WHEEL_QUANTUM_MS - mod_utils.current_millis()
+        loop.call_later(max(delay_ms, 0.0) / 1000.0,
+                        _wheel_fire, loop, bucket)
+    tokens = []
+    for handle in handles:
+        _wheel_tok += 1
+        token = (loop, bucket, _wheel_tok)
+        slot[token] = handle
+        tokens.append(token)
+    return tokens
 
 
 def _wheel_fire(loop, bucket):

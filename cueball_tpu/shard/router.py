@@ -357,6 +357,38 @@ class FleetRouter:
         hdl, conn = await worker.run(pool.claim, options or {})
         return RoutedClaim(self, name, rec.shard_id, hdl, conn)
 
+    async def claim_many(self, name: str, n: int,
+                         options: dict | None = None):
+        """Batched claim routed to the owning shard: one cross-loop
+        hop claims the whole batch via ``pool.claim_many`` (the
+        per-claim marshalling is what claim-per-call spends most of
+        its budget on for thread shards). Returns a list of
+        ``RoutedClaim``s; all-or-nothing like ``pool.claim_many``."""
+        rec, worker, _fsm = self._lookup(name)
+        if worker.backend == 'spawn':
+            raise CueBallError(
+                'per-claim routing is not available on the spawn '
+                'backend; submit a job instead')
+        self.fr_submits[rec.shard_id] += 1
+        pool = rec.pool
+        pairs = await worker.run(pool.claim_many, n, options or {})
+        return [RoutedClaim(self, name, rec.shard_id, hdl, conn)
+                for hdl, conn in pairs]
+
+    async def release_many(self, claims) -> None:
+        """Release a batch of RoutedClaims, one hop per owning shard
+        (grouped) instead of one per claim."""
+        by_shard: dict = {}
+        for rc in claims:
+            by_shard.setdefault((rc.rc_shard, rc.rc_name),
+                                []).append(rc)
+        for (_sid, name), group in by_shard.items():
+            handles = [rc.handle for rc in group]
+
+            def release_job(pool, hs=handles):
+                pool.release_many(hs)
+            await self.submit(name, release_job)
+
     async def submit(self, name: str, job, *args, **kwargs):
         """Run ``job(pool, *args, **kwargs)`` on the shard owning pool
         ``name`` and return its result. For the spawn backend ``job``

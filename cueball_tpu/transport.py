@@ -1,0 +1,359 @@
+"""The byte-moving seam: every real socket the framework touches.
+
+Before this module, raw asyncio socket plumbing was scattered across
+five call sites — the DNS wire client opened datagram endpoints and
+TCP streams itself (dns_client.py), the HTTP agent called
+``loop.create_connection`` and set keep-alive sockopts (agent.py), the
+kang debug server called ``asyncio.start_server`` (http_server.py),
+the pool monitor read the host ident straight off the socket module
+(monitor.py), and netsim substituted each seam ad hoc. Following the
+policy/data-path separation of "An Extensible Software Transport
+Layer for GPU Networking" (PAPERS.md), the protocol decisions stay
+where they were (sans-io cores: ``dns_client.DnsQueryCore``, the FSM
+engines, the HTTP parsers) and everything that actually moves bytes
+lands here, behind one ``Transport`` interface:
+
+- :class:`AsyncioTransport` — the default; today's behavior, and the
+  ONE place in the package (outside ``netsim/``) allowed to import
+  ``socket`` or touch loop socket APIs (``make check`` enforces this
+  via the cblint C110 layering rule).
+- :class:`FabricTransport` — netsim's virtual data plane as a
+  transport: the pool constructor seam is ``fabric.constructor``, the
+  DNS seam is a ``SimWire``; no real socket exists anywhere. The
+  parity gate (tests/test_transport_parity.py) runs the full pool and
+  cset soaks on both transports and pins byte-identical FSM
+  transition traces plus matching phase ledgers.
+- :class:`NativeTransport` — the stub surface a ``native/`` C
+  transport plugs into next: the method set IS the plug-in contract.
+
+Pool/FSM semantics do not live here and do not move: a transport
+supplies connections, streams, servers and DNS byte exchanges; who
+claims what, when, is the pool's business. See docs/transport.md.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket as mod_socket
+import struct
+
+from .events import EventEmitter
+
+
+class Transport:
+    """Abstract byte-mover. Subclasses implement the five seams:
+
+    - ``connector(backend)`` — the pool/cset ``options['constructor']``
+      fallback: build one connection-contract object (emits
+      'connect'/'error'/'close', has destroy/ref/unref) for a backend.
+    - ``create_stream(...)`` — one outbound stream (the HTTP agent's
+      socket seam); returns ``(transport, protocol)``.
+    - ``serve(...)`` — one listening server (the kang debug endpoint).
+    - ``dns_udp`` / ``dns_tcp`` — one DNS byte exchange: payload out,
+      raw response bytes back (the sans-io ``DnsQueryCore`` decides
+      what the bytes mean).
+    - ``host_ident()`` — the identity stamped on kang snapshots.
+    """
+
+    name = 'abstract'
+
+    # -- pool constructor seam -------------------------------------------
+
+    def connector(self, backend: dict):
+        raise NotImplementedError(
+            '%s does not supply pool connections' % type(self).__name__)
+
+    # -- stream seam ------------------------------------------------------
+
+    async def create_stream(self, protocol_factory, host, port,
+                            ssl=None, server_hostname=None):
+        raise NotImplementedError(
+            '%s does not open streams' % type(self).__name__)
+
+    def configure_keepalive(self, stream_transport,
+                            delay_ms: float | None = None) -> int | None:
+        """Enable TCP keep-alive on an established stream; returns the
+        local port when one exists (None on non-socket transports)."""
+        return None
+
+    # -- server seam ------------------------------------------------------
+
+    async def serve(self, client_connected_cb, host, port):
+        raise NotImplementedError(
+            '%s does not listen' % type(self).__name__)
+
+    # -- DNS wire seam ----------------------------------------------------
+
+    async def dns_udp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        raise NotImplementedError(
+            '%s does not move DNS datagrams' % type(self).__name__)
+
+    async def dns_tcp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        raise NotImplementedError(
+            '%s does not move DNS streams' % type(self).__name__)
+
+    # -- identity ---------------------------------------------------------
+
+    def host_ident(self) -> str:
+        return mod_socket.gethostname()
+
+
+class WatchedStreamProtocol(asyncio.StreamReaderProtocol):
+    """StreamReaderProtocol that reports connection loss to an owner
+    even while the stream sits idle in a pool. Node's net.Socket emits
+    'close' on FIN regardless of reads; plain asyncio streams only
+    surface EOF at the next read, which would leave dead idle
+    connections undetected until claimed. The owner implements
+    ``_on_connection_lost(exc)``."""
+
+    def __init__(self, reader, owner, loop):
+        super().__init__(reader, loop=loop)
+        self._owner = owner
+
+    def eof_received(self):
+        super().eof_received()
+        # Close on FIN rather than lingering half-open (node's
+        # allowHalfOpen=false default) so connection_lost fires and
+        # the pool learns the backend hung up.
+        return False
+
+    def connection_lost(self, exc):
+        super().connection_lost(exc)
+        self._owner._on_connection_lost(exc)
+
+
+class TcpStreamConnection(EventEmitter):
+    """Connection-contract object over a transport stream: the default
+    ``AsyncioTransport.connector`` product, and the real-socket twin
+    of netsim's SimConnection (the parity soaks run one pool on each).
+    Emits 'connect' once the stream is up, 'error'/'close' on loss;
+    ``reader``/``writer`` are live after 'connect'."""
+
+    def __init__(self, transport: Transport, backend: dict):
+        super().__init__()
+        self.transport = transport
+        self.backend = backend
+        self.reader: asyncio.StreamReader | None = None
+        self.writer: asyncio.StreamWriter | None = None
+        self.destroyed = False
+        self._task = asyncio.ensure_future(self._connect())
+
+    def _on_connection_lost(self, exc):
+        if self.destroyed:
+            return
+        if exc is not None:
+            self.emit('error', exc)
+        else:
+            self.emit('close')
+
+    async def _connect(self):
+        try:
+            loop = asyncio.get_running_loop()
+            reader = asyncio.StreamReader(loop=loop)
+            stream, protocol = await self.transport.create_stream(
+                lambda: WatchedStreamProtocol(reader, self, loop),
+                self.backend['address'], self.backend['port'])
+            self.reader = reader
+            self.writer = asyncio.StreamWriter(
+                stream, protocol, reader, loop)
+            self.emit('connect')
+        except OSError as e:
+            self.emit('error', e)
+        except asyncio.CancelledError:
+            pass
+
+    def destroy(self):
+        self.destroyed = True
+        if self.writer is not None:
+            self.writer.close()
+        elif not self._task.done():
+            self._task.cancel()
+
+    def ref(self):
+        pass
+
+    def unref(self):
+        pass
+
+
+class _UdpQuery(asyncio.DatagramProtocol):
+    """One-shot DNS datagram exchange. Datagrams whose transaction ID
+    doesn't match the query are dropped: qid randomization is the
+    anti-spoofing entropy and is useless unless checked on receive."""
+
+    def __init__(self, fut: asyncio.Future, qid: int):
+        self.fut = fut
+        self.qid = qid
+
+    def datagram_received(self, data, addr):
+        if len(data) < 2 or \
+                struct.unpack('>H', data[:2])[0] != self.qid:
+            return
+        if not self.fut.done():
+            self.fut.set_result(data)
+
+    def error_received(self, exc):
+        if not self.fut.done():
+            self.fut.set_exception(exc)
+
+
+class AsyncioTransport(Transport):
+    """The default transport: real sockets on the running asyncio
+    loop. All raw plumbing formerly inlined in dns_client.query_udp /
+    query_tcp, agent.HttpSocket._connect and http_server.serve_monitor
+    lives here now."""
+
+    name = 'asyncio'
+
+    def connector(self, backend: dict) -> TcpStreamConnection:
+        return TcpStreamConnection(self, backend)
+
+    async def create_stream(self, protocol_factory, host, port,
+                            ssl=None, server_hostname=None):
+        loop = asyncio.get_running_loop()
+        kwargs = {}
+        if ssl is not None:
+            kwargs['ssl'] = ssl
+            kwargs['server_hostname'] = server_hostname
+        return await loop.create_connection(
+            protocol_factory, host, port, **kwargs)
+
+    def configure_keepalive(self, stream_transport,
+                            delay_ms: float | None = None) -> int | None:
+        sock = stream_transport.get_extra_info('socket')
+        if sock is None:
+            return None
+        # Keep-alive is always on (reference lib/agent.js:52,188-191);
+        # the optional delay maps to TCP_KEEPIDLE.
+        sock.setsockopt(mod_socket.SOL_SOCKET,
+                        mod_socket.SO_KEEPALIVE, 1)
+        if delay_ms is not None and hasattr(mod_socket, 'TCP_KEEPIDLE'):
+            sock.setsockopt(mod_socket.IPPROTO_TCP,
+                            mod_socket.TCP_KEEPIDLE,
+                            max(1, int(delay_ms / 1000)))
+        return sock.getsockname()[1]
+
+    async def serve(self, client_connected_cb, host, port):
+        return await asyncio.start_server(
+            client_connected_cb, host, port)
+
+    async def dns_udp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        qid = struct.unpack('>H', payload[:2])[0]
+        stream, _ = await loop.create_datagram_endpoint(
+            lambda: _UdpQuery(fut, qid), remote_addr=(resolver, port))
+        try:
+            stream.sendto(payload)
+            return await asyncio.wait_for(fut, timeout_s)
+        finally:
+            stream.close()
+
+    async def dns_tcp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(resolver, port), timeout_s)
+        try:
+            writer.write(struct.pack('>H', len(payload)) + payload)
+            await writer.drain()
+            ln = struct.unpack('>H', await asyncio.wait_for(
+                reader.readexactly(2), timeout_s))[0]
+            return await asyncio.wait_for(
+                reader.readexactly(ln), timeout_s)
+        finally:
+            writer.close()
+
+
+class FabricTransport(Transport):
+    """netsim's virtual data plane as a transport. ``fabric`` is a
+    ``cueball_tpu.netsim.Fabric`` (duck-typed — this module never
+    imports netsim); ``wire`` is an optional ``SimWire``-shaped DNS
+    byte mover. No real socket exists anywhere: connections are
+    SimConnections on virtual timers, so the same pool workload runs
+    byte-identically from a seed."""
+
+    name = 'fabric'
+
+    def __init__(self, fabric, wire=None, ident: str = 'netsim'):
+        self.fabric = fabric
+        self.wire = wire
+        self._ident = ident
+
+    def connector(self, backend: dict):
+        return self.fabric.constructor(backend)
+
+    async def dns_udp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        if self.wire is None:
+            raise NotImplementedError(
+                'FabricTransport has no SimWire attached')
+        return await self.wire.udp(resolver, port, payload, timeout_s)
+
+    async def dns_tcp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        if self.wire is None:
+            raise NotImplementedError(
+                'FabricTransport has no SimWire attached')
+        return await self.wire.tcp(resolver, port, payload, timeout_s)
+
+    def host_ident(self) -> str:
+        return self._ident
+
+
+class NativeTransport(Transport):
+    """The plug-in surface for the C data path (native/transport, next
+    PR): a registered-but-stubbed backend so the dispatch plumbing,
+    the registry name and the docs contract all exist before the
+    first native byte moves. Every seam raises until the native module
+    fills it in via :func:`register_transport`."""
+
+    name = 'native'
+
+
+# -- registry ---------------------------------------------------------------
+
+_REGISTRY: dict = {'asyncio': AsyncioTransport, 'native': NativeTransport}
+_default: Transport | None = None
+
+
+def register_transport(name: str, factory) -> None:
+    """Register a transport factory (a zero-arg callable returning a
+    Transport) under ``name`` for ``get_transport(name)`` / the pool's
+    ``options['transport']`` string form."""
+    _REGISTRY[name] = factory
+
+
+def get_transport(spec=None) -> Transport:
+    """Resolve a transport: None -> the process-default
+    AsyncioTransport singleton, a string -> the registry, a Transport
+    instance -> itself."""
+    global _default
+    if spec is None:
+        if _default is None:
+            _default = AsyncioTransport()
+        return _default
+    if isinstance(spec, str):
+        factory = _REGISTRY.get(spec)
+        if factory is None:
+            raise ValueError('unknown transport %r (registered: %s)' % (
+                spec, ', '.join(sorted(_REGISTRY))))
+        return factory()
+    if isinstance(spec, Transport):
+        return spec
+    raise TypeError('transport must be None, a name or a Transport, '
+                    'got %r' % (spec,))
+
+
+def host_ident() -> str:
+    """The default transport's host identity (what monitor.py stamps
+    on kang snapshots instead of touching the socket module)."""
+    return get_transport().host_ident()
+
+
+__all__ = ['Transport', 'AsyncioTransport', 'FabricTransport',
+           'NativeTransport', 'TcpStreamConnection',
+           'WatchedStreamProtocol', 'register_transport',
+           'get_transport', 'host_ident']

@@ -105,7 +105,8 @@ def update_error_metrics(collector: 'mod_metrics.Collector', uuid: str,
     """Count a whitelisted error event (reference lib/utils.js:421-444)."""
     if err_str not in METRIC_ERROR_EVENTS:
         return
-    import socket as mod_socket
+    # Hostname for a metric label, not byte movement.
+    import socket as mod_socket  # cblint: ignore=C110
     counter = collector.get_collector(METRIC_CUEBALL_EVENT_COUNTER)
     counter.increment({
         'hostname': mod_socket.gethostname(),

@@ -1,0 +1,96 @@
+"""Trickled TCP segments mid-claim-handshake (FabricTransport).
+
+The transport seam lets netsim express a fault no socket-level fake
+could: a middlebox that accepts the connection but then dribbles the
+claim-time handshake out segment by segment. ``LinkModel``'s
+``trickle_segments``/``trickle_ms`` drive SimConnection's
+``cb_claim_ready`` probe, which the slot FSM consults before handing
+the socket to a claim — the handle sits in 'claiming' for the whole
+dribble, so ALL of the stall lands in the phase ledger's `handshake`
+column while `queue_wait` stays flat (the claim was served an idle
+slot immediately; it just couldn't use it yet).
+
+Runs inside the Scenario harness: any assertion failure writes a
+.netsim-failures/ replay dump that embeds the phase ledger of the
+slowest claims, and the run must replay byte-identically from its
+seed (pinned across 5 seeds below).
+"""
+
+import asyncio
+
+from cueball_tpu import netsim
+from cueball_tpu import profile as mod_profile
+from cueball_tpu import trace as mod_trace
+from cueball_tpu.transport import FabricTransport
+
+import pytest
+
+import scenario_common as sco
+
+SEGMENTS = 5
+TRICKLE_MS = 10.0
+# Virtual milliseconds the dribble adds to every claim: N timer hops.
+STALL_MS = SEGMENTS * TRICKLE_MS
+
+
+def _run(seed, trickle_segments):
+    """One seeded run -> (transition trace, per-claim ledgers)."""
+    fabric = netsim.Fabric()
+    sc = netsim.Scenario('trickle-handshake', seed=seed)
+    result = {}
+
+    async def main():
+        backends = [{'address': '10.0.0.1', 'port': 80}]
+        fabric.set_link('10.0.0.1:80', latency_ms=1.0,
+                        trickle_segments=trickle_segments,
+                        trickle_ms=TRICKLE_MS)
+        pool, res = sco.make_sim_pool(
+            fabric, backends, spares=2, maximum=2,
+            constructor=None, transport=FabricTransport(fabric))
+        await sco.wait_state(pool, 'running', timeout_s=20.0)
+
+        mod_trace.enable_tracing(ring_size=128, sample_rate=1.0)
+        try:
+            for _ in range(10):
+                assert await sco.claim_release(pool, timeout_ms=5000.0)
+                await asyncio.sleep(0.01)
+            result['ledgers'] = mod_profile.phase_ledger()
+        finally:
+            mod_trace.disable_tracing()
+        await sco.stop_pool(pool, res)
+
+    sc.run(lambda: main())
+    return list(sc.trace), result['ledgers']
+
+
+@pytest.mark.parametrize('seed', [11, 22, 33, 44, 55])
+def test_trickle_inflates_handshake_not_queue_wait(seed):
+    _trace, ledgers = _run(seed, SEGMENTS)
+    _ctrace, control = _run(seed, 0)
+    assert len(ledgers) == 10 and len(control) == 10
+    for led, base in zip(ledgers, control):
+        assert led['outcome'] == base['outcome'] == 'released'
+        # Every claim ate the full dribble in the handshake phase
+        # (up to float addition across the N timer hops)...
+        assert led['phases']['handshake'] >= STALL_MS - 0.001
+        # ...the control run's handshake never saw it...
+        assert base['phases']['handshake'] < STALL_MS
+        # ...and queue_wait stayed flat: the claim was SERVED promptly
+        # on both runs; only the post-serve handshake stalled.
+        assert led['phases']['queue_wait'] <= \
+            base['phases']['queue_wait'] + 1.0
+
+
+@pytest.mark.parametrize('seed', [11, 22, 33, 44, 55])
+def test_trickle_run_is_deterministic(seed):
+    """Same seed, same script -> byte-identical transition trace AND
+    identical phase ledgers (virtual clock: ledger times are exact)."""
+    trace_a, ledgers_a = _run(seed, SEGMENTS)
+    trace_b, ledgers_b = _run(seed, SEGMENTS)
+    assert len(trace_a) > 50
+    assert trace_a == trace_b
+    strip = [{k: v for k, v in led.items() if k != 'trace_id'}
+             for led in ledgers_a]
+    strip_b = [{k: v for k, v in led.items() if k != 'trace_id'}
+               for led in ledgers_b]
+    assert strip == strip_b

@@ -1,0 +1,174 @@
+"""Batched claim path: ConnectionPool.claim_many / release_many.
+
+claim_many(n) mints n claim handles through ONE options parse, one
+pool-state check, one counter bump ('claim' += n), one deferred
+dispatch, and — for the handles that park — one batched timer-wheel
+arm and one telemetry/rebalance pass. The semantics per handle are
+IDENTICAL to n looped claims (same FSM walk, same timeout/cancel
+behavior, same errors); only the bookkeeping is amortized, which is
+what bench.py's claim_many_ops_per_sec stage measures. These tests
+pin the semantic half of that contract.
+"""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu import errors as mod_errors
+
+from conftest import run_async, settle, wait_for_state
+from test_pool import Ctx, make_pool
+
+
+async def _ready_pool(ctx, **opts):
+    pool, inner = make_pool(ctx, **opts)
+    inner.emit('added', 'b1', {'key': 'b1', 'address': '1.2.3.4',
+                               'port': 111})
+    await settle()
+    for c in list(ctx.connections):
+        if not c.connected:
+            c.connect()
+    await wait_for_state(pool, 'running')
+    await settle()
+    return pool, inner
+
+
+async def _stop(pool):
+    pool.stop()
+    await wait_for_state(pool, 'stopped')
+
+
+def test_claim_many_zero_returns_empty():
+    async def t():
+        ctx = Ctx()
+        pool, _inner = await _ready_pool(ctx)
+        assert await pool.claim_many(0) == []
+        await _stop(pool)
+    run_async(t())
+
+
+def test_claim_many_validates_n():
+    async def t():
+        ctx = Ctx()
+        pool, _inner = await _ready_pool(ctx)
+        for bad in (-1, 1.5, 'x', None):
+            with pytest.raises(AssertionError):
+                pool.claim_many_cb(bad, {}, lambda e, h=None, c=None: None)
+        await _stop(pool)
+    run_async(t())
+
+
+def test_claim_many_serves_idle_slots_in_one_batch():
+    async def t():
+        ctx = Ctx()
+        pool, _inner = await _ready_pool(ctx, spares=4, maximum=4)
+        before = pool.get_stats()['counters'].get('claim', 0)
+        pairs = await pool.claim_many(4)
+        assert len(pairs) == 4
+        assert len({id(conn) for _h, conn in pairs}) == 4
+        for hdl, conn in pairs:
+            assert hdl.is_in_state('claimed')
+            assert conn.connected
+        stats = pool.get_stats()['counters']
+        # One bump of n, not n bumps of one.
+        assert stats.get('claim', 0) - before == 4
+        # Nobody parked: the whole batch was served from the idleq.
+        assert stats.get('queued-claim', 0) == 0
+        pool.release_many([h for h, _c in pairs])
+        await settle()
+        assert all(h.is_in_state('released') for h, _c in pairs)
+        await _stop(pool)
+    run_async(t())
+
+
+def test_claim_many_parks_overflow_and_serves_on_release():
+    async def t():
+        ctx = Ctx()
+        pool, _inner = await _ready_pool(ctx, spares=2, maximum=2)
+        first = await pool.claim_many(2)
+        task = asyncio.ensure_future(pool.claim_many(2))
+        await settle()
+        assert len(pool.p_waiters) == 2
+        assert pool.get_stats()['counters'].get('queued-claim', 0) == 2
+        assert not task.done()
+        pool.release_many([h for h, _c in first])
+        pairs = await task
+        assert len(pairs) == 2
+        assert all(h.is_in_state('claimed') for h, _c in pairs)
+        pool.release_many([h for h, _c in pairs])
+        await settle()
+        await _stop(pool)
+    run_async(t())
+
+
+def test_claim_many_timeout_releases_partial_successes():
+    """If any handle in the batch fails, the successes are returned
+    to the pool and the FIRST error surfaces — callers never leak
+    half a batch."""
+    async def t():
+        ctx = Ctx()
+        pool, _inner = await _ready_pool(ctx, spares=2, maximum=2)
+        with pytest.raises(mod_errors.ClaimTimeoutError):
+            # 2 slots exist: two claims land, the third times out.
+            await pool.claim_many(3, {'timeout': 50})
+        await settle()
+        # The two successful claims were auto-released back.
+        assert len(pool.p_idleq) == 2 or not pool.p_waiters
+        pairs = await pool.claim_many(2, {'timeout': 1000})
+        assert len(pairs) == 2
+        pool.release_many([h for h, _c in pairs])
+        await settle()
+        await _stop(pool)
+    run_async(t())
+
+
+def test_claim_many_cancellation_cancels_all_waiters():
+    async def t():
+        ctx = Ctx()
+        pool, _inner = await _ready_pool(ctx, spares=1, maximum=1)
+        hold = await pool.claim_many(1)
+        task = asyncio.ensure_future(pool.claim_many(2))
+        await settle()
+        assert len(pool.p_waiters) == 2
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        await settle()
+        assert not pool.p_waiters
+        pool.release_many([h for h, _c in hold])
+        await settle()
+        await _stop(pool)
+    run_async(t())
+
+
+def test_claim_many_fails_fast_when_pool_stopped():
+    async def t():
+        ctx = Ctx()
+        pool, _inner = await _ready_pool(ctx)
+        await _stop(pool)
+        with pytest.raises(mod_errors.PoolStoppingError):
+            await pool.claim_many(2)
+    run_async(t())
+
+
+def test_claim_many_callable_options_shuffle():
+    """claim_many_cb(n, cb) — options omitted, callback in its place —
+    mirrors claim_cb's signature shuffle."""
+    async def t():
+        ctx = Ctx()
+        pool, _inner = await _ready_pool(ctx, spares=2, maximum=2)
+        fut = asyncio.get_running_loop().create_future()
+        got = []
+
+        def cb(err, hdl=None, conn=None):
+            got.append((err, hdl, conn))
+            if len(got) == 2 and not fut.done():
+                fut.set_result(got)
+        handles = pool.claim_many_cb(2, cb)
+        assert len(handles) == 2
+        for err, hdl, conn in await fut:
+            assert err is None
+            hdl.release()
+        await settle()
+        await _stop(pool)
+    run_async(t())

@@ -409,3 +409,53 @@ def test_dump_renders_spawn_router_and_health_with_dead_child():
             except Exception:
                 pass    # a terminated child may fail the handshake
     run_async(main(), timeout=120.0)
+
+
+def test_kang_health_and_profile_reject_malformed_params():
+    """/kang/health and /kang/profile answer malformed query params
+    with 400 JSON error bodies, the /kang/traces convention: unknown
+    parameters, non-integer or negative limits, unknown phase names.
+    Valid inputs (including the limit=0 edge) still serve 200."""
+    from cueball_tpu.http_server import serve_monitor
+    from test_monitor import _get
+
+    async def main():
+        server = await serve_monitor()
+        port = server.sockets[0].getsockname()[1]
+        try:
+            status, body = await _get(port, '/kang/health?limit=abc')
+            assert status == 400
+            assert body == {'error': "limit must be an integer, "
+                                     "got 'abc'"}
+            status, body = await _get(port, '/kang/health?limit=-2')
+            assert status == 400
+            assert body == {'error': 'limit must be >= 0, got -2'}
+            status, body = await _get(port, '/kang/health?bogus=1')
+            assert status == 400
+            assert body == {'error': 'unknown parameter(s) bogus; '
+                                     'supported: limit'}
+            # One bad parameter rejects even when the other is fine.
+            status, body = await _get(port,
+                                      '/kang/health?limit=1&bogus=1')
+            assert status == 400 and 'unknown parameter' in body['error']
+
+            status, body = await _get(port, '/kang/profile?phase=nope')
+            assert status == 400
+            assert body['error'].startswith("unknown phase 'nope'")
+            assert 'handshake' in body['error']
+            status, body = await _get(port, '/kang/profile?limit=1')
+            assert status == 400
+            assert body == {'error': 'unknown parameter(s) limit; '
+                                     'supported: phase'}
+
+            status, body = await _get(port, '/kang/health?limit=0')
+            assert status == 200 and body['monitors'] == []
+            status, body = await _get(port, '/kang/health?limit=5')
+            assert status == 200
+            status, body = await _get(port,
+                                      '/kang/profile?phase=handshake')
+            assert status == 200
+        finally:
+            server.close()
+            await server.wait_closed()
+    run_async(main())

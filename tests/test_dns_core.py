@@ -1,0 +1,181 @@
+"""Sans-io conformance for the pure DNS protocol core.
+
+``dns_client.DnsQueryCore`` owns every wire-level DNS decision — EDNS
+fallback on FORMERR/NOTIMP (RFC 6891 6.2.2), TC-bit escalation to
+TCP, rcode policy, malformed-packet propagation — with no loop, no
+sockets, no timers. These tests feed it the exact byte scripts
+netsim's SimWire middlebox serves (same encoders, same truncation
+arithmetic) and pin that the pure core walks the same decision
+sequence the transport-driven client does: the verb stream from
+``begin()``/``on_response()`` must match the ``wire.log`` proto
+stream of a real ``DnsClient`` lookup against the same misbehavior.
+
+Timeouts deliberately have no conformance case on the core itself:
+a blackholed resolver never produces bytes, so there is no core
+decision to make — the deadline belongs to the transport driver, and
+the cross-check asserts the core was never consulted.
+"""
+
+import asyncio
+import random
+import struct
+
+import pytest
+
+from cueball_tpu import netsim
+from cueball_tpu.dns_client import DnsClient, DnsError, DnsQueryCore
+from cueball_tpu.netsim.dns import encode_response, parse_query
+
+
+def _zone():
+    zone = netsim.SimZone()
+    zone.add('a.sim', 'A', '1.2.3.4', ttl=30)
+    return zone
+
+
+def _core(resolver='9.9.9.1'):
+    return DnsQueryCore('a.sim', 'A', rng=random.Random(7),
+                        resolver=resolver)
+
+
+def _answer_for(payload, **kwargs):
+    """Encode the SimWire 'ok' response for a query payload — the same
+    codec path SimWire._answer runs, minus the loop."""
+    qid, domain, qtype, _opt = parse_query(payload)
+    return encode_response(qid, domain, qtype, rcode='NOERROR',
+                           answers=[{'name': domain, 'type': qtype,
+                                     'ttl': 30, 'target': '1.2.3.4'}],
+                           **kwargs)
+
+
+async def _wire_protos(behavior):
+    """The transport-driven decision stream: a real DnsClient lookup
+    through SimWire with `behavior`, returning the protos it used."""
+    wire = netsim.SimWire(_zone(), behaviors={'9.9.9.1': behavior})
+    client = DnsClient(transport=wire)
+    fut = asyncio.get_running_loop().create_future()
+    client.lookup({'domain': 'a.sim', 'type': 'A', 'timeout': 1000,
+                   'resolvers': ['9.9.9.1']},
+                  lambda e, m: fut.set_result((e, m)))
+    err, msg = await fut
+    return [entry[0] for entry in wire.log], err, msg
+
+
+def test_formerr_edns_falls_back_to_plain_udp():
+    core = _core()
+    verb, payload = core.begin()
+    assert verb == 'udp'
+    qid, domain, qtype, has_opt = parse_query(payload)
+    assert (domain, qtype, has_opt) == ('a.sim', 'A', True)
+
+    # Legacy middlebox FORMERRs the OPT-bearing query: one plain
+    # RFC 1035 retry, still UDP, no EDNS record, fresh qid.
+    verb, retry = core.on_response(
+        encode_response(qid, domain, qtype, rcode='FORMERR'))
+    assert verb == 'udp'
+    qid2, _domain, _qtype, has_opt2 = parse_query(retry)
+    assert has_opt2 is False
+
+    verb, msg = core.on_response(_answer_for(retry))
+    assert verb == 'done'
+    assert msg.get_answers()[0]['target'] == '1.2.3.4'
+
+    # Identical decision stream to the transport-driven client.
+    protos, err, _msg = netsim.run(_wire_protos('formerr-edns'), seed=1)
+    assert err is None
+    assert protos == ['udp', 'udp']
+
+
+def test_notimp_edns_falls_back_to_plain_udp():
+    core = _core()
+    _verb, payload = core.begin()
+    qid, domain, qtype, _opt = parse_query(payload)
+    verb, retry = core.on_response(
+        encode_response(qid, domain, qtype, rcode='NOTIMP'))
+    assert verb == 'udp'
+    assert parse_query(retry)[3] is False
+
+
+def test_formerr_after_fallback_is_an_error_not_a_loop():
+    """FORMERR on the PLAIN query is a real server error: the
+    RFC 6891 fallback fires once, from the EDNS state only."""
+    core = _core()
+    _verb, payload = core.begin()
+    _verb, retry = core.on_response(
+        encode_response(parse_query(payload)[0], 'a.sim', 'A',
+                        rcode='FORMERR'))
+    with pytest.raises(DnsError) as ei:
+        core.on_response(encode_response(parse_query(retry)[0],
+                                         'a.sim', 'A',
+                                         rcode='FORMERR'))
+    assert ei.value.code == 'FORMERR'
+
+
+def test_tc_bit_escalates_to_tcp_with_same_payload():
+    core = _core()
+    _verb, payload = core.begin()
+    # Truncating middlebox: TC bit set, empty answer section.
+    verb, tcp_payload = core.on_response(
+        _answer_for(payload, tc=True))
+    assert verb == 'tcp'
+    # The TCP retry reuses the same encoded query byte-for-byte.
+    assert tcp_payload == payload
+
+    verb, msg = core.on_response(_answer_for(tcp_payload))
+    assert verb == 'done'
+    assert msg.get_answers()[0]['target'] == '1.2.3.4'
+
+    protos, err, _msg = netsim.run(_wire_protos('tc-udp'), seed=1)
+    assert err is None
+    assert protos == ['udp', 'tcp']
+
+
+def test_tc_after_edns_fallback_still_escalates():
+    core = _core()
+    _verb, payload = core.begin()
+    qid = parse_query(payload)[0]
+    _verb, retry = core.on_response(
+        encode_response(qid, 'a.sim', 'A', rcode='FORMERR'))
+    verb, tcp_payload = core.on_response(_answer_for(retry, tc=True))
+    assert verb == 'tcp'
+    assert tcp_payload == retry
+
+
+def test_truncated_packet_raises_parse_error():
+    """SimWire 'truncate' cuts the response mid-record; the core
+    propagates the struct error (the driver maps it to a malformed-
+    response ValueError without giving up the whole lookup)."""
+    core = _core()
+    _verb, payload = core.begin()
+    full = _answer_for(payload)
+    with pytest.raises(struct.error):
+        core.on_response(full[:max(13, len(full) - 7)])
+
+    protos, err, msg = netsim.run(_wire_protos('truncate'), seed=1)
+    assert err is not None and msg is None
+
+
+def test_bad_rcode_raises_dns_error_carrying_resolver():
+    core = _core(resolver='9.9.9.9')
+    _verb, payload = core.begin()
+    with pytest.raises(DnsError) as ei:
+        core.on_response(encode_response(parse_query(payload)[0],
+                                         'a.sim', 'A',
+                                         rcode='SERVFAIL'))
+    assert ei.value.code == 'SERVFAIL'
+    assert ei.value.resolver == '9.9.9.9'
+
+
+def test_blackhole_never_consults_the_core():
+    """A blackholed resolver delivers no bytes: the timeout decision
+    is the transport driver's, and the pure core is never advanced
+    past its initial state."""
+    core = _core()
+    core.begin()
+    assert core._state == 'udp-edns'   # no response, no transition
+
+    protos, err, _msg = netsim.run(_wire_protos('blackhole'), seed=1)
+    assert err is not None
+    # The wire saw the query; no response bytes ever came back, so
+    # the only proto entries are the driver's own retries.
+    assert all(p == 'udp' for p in protos)

@@ -341,3 +341,39 @@ def test_spawn_backend_runs_jobs_in_child_processes():
         finally:
             await router.stop()
     run_async(main(), timeout=120.0)
+
+
+def test_claim_many_fanout_and_release_many():
+    """router.claim_many crosses to the owning shard ONCE for the
+    whole batch; release_many crosses once per owning shard. The
+    claims behave exactly like looped router.claim results."""
+    async def main():
+        router = FleetRouter({'shards': 2, 'backend': 'thread'})
+        await router.start()
+        await router.create_pool('svc.batch', factory=_bench_fixture_pool)
+        claims = await router.claim_many('svc.batch', 2)
+        assert len(claims) == 2
+        for rc in claims:
+            assert isinstance(rc, RoutedClaim)
+            assert rc.connection is not None
+            assert rc.handle.is_in_state('claimed')
+        await router.release_many(claims)
+        # The slots are reclaimable afterwards: the batch release
+        # really returned them to the pool on the owning loop.
+        again = await router.claim_many('svc.batch', 2)
+        assert len(again) == 2
+        await router.release_many(again)
+        await _stop_pool_and_router(router, 'svc.batch')
+    run_async(main())
+
+
+def test_claim_many_inline_backend():
+    async def main():
+        router = FleetRouter({'shards': 1, 'backend': 'inline'})
+        await router.start()
+        await router.create_pool('svc.inb', factory=_bench_fixture_pool)
+        claims = await router.claim_many('svc.inb', 2)
+        assert [rc.rc_shard for rc in claims] == [0, 0]
+        await router.release_many(claims)
+        await _stop_pool_and_router(router, 'svc.inb')
+    run_async(main())

@@ -121,6 +121,71 @@ def test_suppression_per_code_wrong_code_still_fires(tmp_path):
     assert 'S010' in _codes(tmp_path, src)
 
 
+def _c110_codes(tmp_path, source: bytes, rel='cueball_tpu/mod.py'):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_bytes(source)
+    return {v.code for v in cblint.lint_file(p)}
+
+
+C110_SOURCES = [
+    b'import socket\n',
+    b'import socket as s\n',
+    b'from socket import SOCK_DGRAM\nx = SOCK_DGRAM\n',
+    b'async def f(loop, s):\n    await loop.sock_connect(s, None)\n',
+    b'async def f(loop, s):\n    await loop.sock_recv(s, 1)\n',
+    b'import asyncio\n\n\nasync def f():\n'
+    b'    await asyncio.open_connection("h", 1)\n',
+    b'import asyncio\n\n\nasync def f():\n'
+    b'    await asyncio.start_server(None, "h", 1)\n',
+    b'async def f(loop):\n    await loop.create_connection(None)\n',
+    b'async def f(loop):\n'
+    b'    await loop.create_datagram_endpoint(None)\n',
+    b'async def f(loop):\n    await loop.create_server(None)\n',
+]
+
+
+@pytest.mark.parametrize('src', C110_SOURCES,
+                         ids=list(range(len(C110_SOURCES))))
+def test_c110_flags_byte_movers_inside_package(tmp_path, src):
+    """The transport-layering rule: inside cueball_tpu/, raw socket
+    imports, loop.sock_* syscalls and the loop/asyncio connection
+    factories belong to transport.py and netsim/ only."""
+    assert 'C110' in _c110_codes(tmp_path, src)
+
+
+def test_c110_scope_exempts_seam_fabric_and_outsiders(tmp_path):
+    src = b'import socket\nx = socket.SOCK_DGRAM\n'
+    # transport.py IS the seam; netsim/ is the fabric behind
+    # FabricTransport; code outside the package (tests, tools) is
+    # not cueball_tpu's layering problem.
+    for rel in ('cueball_tpu/transport.py',
+                'cueball_tpu/netsim/fabric2.py',
+                'elsewhere/mod.py',
+                'plain.py'):
+        assert 'C110' not in _c110_codes(tmp_path, src, rel), rel
+
+
+def test_c110_per_line_ignore(tmp_path):
+    src = (b'import socket  # cblint: ignore=C110\n'
+           b'x = socket.SOCK_DGRAM\n')
+    assert 'C110' not in _c110_codes(tmp_path, src)
+    # The ignore is per-line: a second unblessed import still fires.
+    src = (b'import socket  # cblint: ignore=C110\n\n\n'
+           b'async def f(loop, s):\n'
+           b'    await loop.sock_sendall(s, b"x")\n')
+    assert 'C110' in _c110_codes(tmp_path, src)
+
+
+def test_c110_does_not_flag_lookalikes(tmp_path):
+    # A local variable named `socket` (the Socket wrapper idiom in
+    # agent.py) and unrelated attributes must not trip the rule.
+    src = (b'async def f(socket, payload):\n'
+           b'    socket.writer.write(payload)\n'
+           b'    await socket.writer.drain()\n')
+    assert 'C110' not in _c110_codes(tmp_path, src)
+
+
 def test_json_output_mode(tmp_path, capsys):
     bad = tmp_path / 'bad.py'
     bad.write_bytes(b'import os\nx=1\n')

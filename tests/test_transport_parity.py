@@ -1,0 +1,251 @@
+"""Transport parity gate: the sans-io seam must not change behavior.
+
+The transport extraction (cueball_tpu/transport.py) moved every
+byte-moving path behind one interface, with the pool/FSM policy layer
+untouched. The gate that makes the swap safe: the SAME scripted
+pool and cset soaks, run once over AsyncioTransport (real loopback
+sockets) and once over FabricTransport (netsim SimConnections on
+loop timers), must walk byte-identical FSM transition traces — the
+``fsm.add_transition_tracer`` tuple stream that
+test_runq_conformance.py pins across engines — and produce matching
+phase ledgers (per-claim outcomes in the same order, coverage >= 0.95
+on both arms).
+
+The workload is deliberately serialized — one connect or claim
+resolution in flight at a time, quiescence-polled between steps — so
+the transition order is a pure function of pool policy, not of how
+fast either transport's bytes move. It still crosses every claim
+edge: park on a cold pool, demand scale-up, the batched
+claim_many/release_many path, claim timeout via the wheel, cancel
+while parked, release-serves-waiter, and a full stop drain.
+"""
+
+import asyncio
+import random
+
+import cueball_tpu.fsm as mod_fsm
+from cueball_tpu import netsim
+from cueball_tpu import profile as mod_profile
+from cueball_tpu import trace as mod_trace
+from cueball_tpu.cset import ConnectionSet
+from cueball_tpu.errors import ClaimTimeoutError
+from cueball_tpu.pool import ConnectionPool
+from cueball_tpu.resolver import StaticIpResolver
+from cueball_tpu.transport import FabricTransport, get_transport
+
+from conftest import run_async
+
+# No retries/backoff in the workload: gen_delay draws from the global
+# rng per retry, which would entangle the trace with rng state.
+RECOVERY = {'default': {'retries': 1, 'timeout': 2000, 'delay': 10,
+                        'maxDelay': 50, 'delaySpread': 0}}
+
+
+async def _wait(pred, timeout_s=15.0, what='condition'):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    while not pred():
+        if loop.time() > deadline:
+            raise AssertionError('timed out waiting for %s' % what)
+        await asyncio.sleep(0.005)
+
+
+async def _claim(pool, timeout_ms=60000.0):
+    fut = asyncio.get_running_loop().create_future()
+
+    def cb(err, hdl=None, conn=None):
+        if not fut.done():
+            fut.set_result((err, hdl, conn))
+    pool.claim_cb({'timeout': timeout_ms}, cb)
+    err, hdl, conn = await fut
+    return err, hdl, conn
+
+
+def _quiet_timers(fsm_owner):
+    """Cancel the wall-clock maintenance timers (load sampler,
+    periodic rebalance, decoherence shuffle): their firing instants
+    are wall-dependent, so they must not contribute transitions to a
+    trace compared across transports."""
+    for attr in ('p_lp_timer', 'p_rebal_timer_inst',
+                 'p_shuffle_timer_inst', 'cs_rebal_timer_inst',
+                 'cs_shuffle_timer_inst'):
+        t = getattr(fsm_owner, attr, None)
+        if t is not None:
+            t.cancel()
+
+
+class _Arm:
+    """One transport under test: builds the transport, its backend
+    list, and tears down whatever listened."""
+
+    def __init__(self, name, n_backends=1):
+        self.name = name
+        self.n_backends = n_backends
+        self.servers = []
+        self.fabric = None
+
+    async def start(self):
+        if self.name == 'asyncio':
+            backends = []
+            for _ in range(self.n_backends):
+                server = await asyncio.start_server(
+                    lambda r, w: None, '127.0.0.1', 0)
+                self.servers.append(server)
+                backends.append({
+                    'address': '127.0.0.1',
+                    'port': server.sockets[0].getsockname()[1]})
+            return get_transport('asyncio'), backends
+        self.fabric = netsim.Fabric()
+        return FabricTransport(self.fabric), [
+            {'address': '10.0.0.%d' % (i + 1), 'port': 80}
+            for i in range(self.n_backends)]
+
+    async def stop(self):
+        for server in self.servers:
+            server.close()
+            await server.wait_closed()
+
+
+async def _pool_soak(transport, backends):
+    res = StaticIpResolver({'backends': backends})
+    pool = ConnectionPool({
+        'domain': 'parity.test',
+        'transport': transport,
+        'resolver': res,
+        'spares': 1,
+        'maximum': 2,
+        'recovery': RECOVERY,
+    })
+    _quiet_timers(pool)
+    res.start()
+
+    # Cold-pool claim: parks until the first slot's connect lands.
+    err, a_hdl, a_conn = await _claim(pool)
+    assert err is None
+    # Demand scale-up: the only slot is held, so this claim forces
+    # slot 2 up and waits out its connect (socket_wait in the ledger).
+    err, b_hdl, b_conn = await _claim(pool)
+    assert err is None
+
+    # Batched path: both slots held, so claim_many(2) parks both
+    # handles in one dispatch, then the serial releases below serve
+    # them one at a time through the requeue path.
+    many_task = asyncio.ensure_future(pool.claim_many(2))
+    await _wait(lambda: len(pool.p_waiters) >= 2, what='claim_many park')
+    a_hdl.release()
+    b_hdl.release()
+    pairs = await many_task
+    assert len(pairs) == 2
+
+    # Claim timeout through the wheel: both slots are held by the
+    # batch, nothing else is in flight, the deadline is the only
+    # pending event.
+    err, t_hdl, _ = await _claim(pool, timeout_ms=40.0)
+    assert isinstance(err, ClaimTimeoutError)
+
+    # Cancel while parked.
+    c_state = {'seen': None}
+    c_hdl = pool.claim_cb(
+        {'timeout': 60000.0},
+        lambda e, h=None, c=None: c_state.__setitem__('seen', e))
+    await _wait(lambda: len(pool.p_waiters) >= 1, what='cancel park')
+    c_hdl.cancel()
+    await _wait(lambda: c_hdl.is_in_state('cancelled'),
+                what='handle cancelled')
+
+    pool.release_many([hdl for hdl, _conn in pairs])
+    await _wait(lambda: not pool.p_waiters, what='drained waiters')
+
+    pool.stop()
+    await _wait(lambda: pool.is_in_state('stopped'), what='pool stop')
+    res.stop()
+    await asyncio.sleep(0.05)
+
+
+async def _cset_soak(transport, backends):
+    res = StaticIpResolver({'backends': backends})
+    cset = ConnectionSet({
+        'domain': 'parity.test',
+        'transport': transport,
+        'resolver': res,
+        'target': 1,
+        'maximum': 2,
+        'recovery': RECOVERY,
+    })
+    _quiet_timers(cset)
+    added = []
+    cset.on('added', lambda key, conn, hdl: added.append(key))
+    cset.on('removed', lambda key, conn, hdl: hdl.release())
+    res.start()
+
+    await _wait(lambda: len(added) >= 1, what='first cset member')
+    cset.set_target(2)
+    await _wait(lambda: len(added) >= 2, what='second cset member')
+    cset.set_target(1)
+    await _wait(lambda: len(cset.get_connections()) == 1,
+                what='scale-down to one')
+
+    cset.stop()
+    await _wait(lambda: cset.is_in_state('stopped'), what='cset stop')
+    res.stop()
+    await asyncio.sleep(0.05)
+
+
+def _run_arm(arm_name, soak, n_backends=1):
+    """One soak on one transport: returns (transition trace, per-claim
+    ledgers). Tracing and the transition tracer wrap the whole run.
+    The global rng is pinned per arm (and restored): resolver-added
+    backends insert into the preference list at random positions, so
+    both arms must consume the same draw stream."""
+    events = []
+
+    def tracer(fsm_obj, old, new):
+        events.append((type(fsm_obj).__name__, old, new))
+
+    async def main():
+        arm = _Arm(arm_name, n_backends)
+        transport, backends = await arm.start()
+        mod_fsm.add_transition_tracer(tracer)
+        try:
+            await soak(transport, backends)
+        finally:
+            mod_fsm.remove_transition_tracer(tracer)
+            await arm.stop()
+
+    rng_state = random.getstate()
+    random.seed(0xC0EBA11)
+    mod_trace.enable_tracing(ring_size=256, sample_rate=1.0)
+    try:
+        run_async(main(), timeout=60)
+        ledgers = mod_profile.phase_ledger()
+    finally:
+        mod_trace.disable_tracing()
+        random.setstate(rng_state)
+    return events, ledgers
+
+
+def _assert_parity(asy, fab):
+    """The gate: byte-identical transition traces, matching ledgers."""
+    asy_events, asy_ledgers = asy
+    fab_events, fab_ledgers = fab
+    assert len(asy_events) > 40   # the soak actually drove the FSMs
+    assert asy_events == fab_events
+    # Matching ledgers: same claims in the same order with the same
+    # outcomes and the same load-bearing phases; absolute times differ
+    # (real sockets vs virtual latency) but attribution must not.
+    assert [led['outcome'] for led in asy_ledgers] == \
+        [led['outcome'] for led in fab_ledgers]
+    assert len(asy_ledgers) > 0
+    for ledgers in (asy_ledgers, fab_ledgers):
+        summary = mod_profile.ledger_summary(ledgers)
+        assert summary['coverage'] >= 0.95, summary
+
+
+def test_pool_soak_parity_asyncio_vs_fabric():
+    _assert_parity(_run_arm('asyncio', _pool_soak),
+                   _run_arm('fabric', _pool_soak))
+
+
+def test_cset_soak_parity_asyncio_vs_fabric():
+    _assert_parity(_run_arm('asyncio', _cset_soak, n_backends=2),
+                   _run_arm('fabric', _cset_soak, n_backends=2))

@@ -389,6 +389,81 @@ class _CorrectnessVisitor(ast.NodeVisitor):
                     'imported but unused: %s' % dotted))
 
 
+# -- transport layering (C110) ------------------------------------------
+
+# The byte-moving primitives the transport seam exists to contain:
+# raw socket imports, the loop's sock_* syscall wrappers, and the
+# loop/asyncio connection factories. Inside cueball_tpu/ these may
+# appear ONLY in transport.py (the seam itself) and netsim/ (the
+# other licensed byte-mover, behind FabricTransport).
+_SOCK_METHOD_RE = re.compile(r'^sock_\w+$')
+_BYTE_FACTORIES = {
+    'open_connection', 'open_unix_connection',
+    'start_server', 'start_unix_server',
+    'create_connection', 'create_unix_connection',
+    'create_datagram_endpoint', 'create_server',
+}
+_C110_MSG = ('byte-moving call outside the transport seam (only '
+             'transport.py and netsim/ may touch sockets; route '
+             'through a Transport)')
+
+
+def layering_applies(path: str) -> bool:
+    """C110 is scoped to the cueball_tpu package proper; transport.py
+    IS the seam and netsim/ is the fabric behind FabricTransport."""
+    parts = Path(path).parts
+    if 'cueball_tpu' not in parts:
+        return False
+    rel = parts[parts.index('cueball_tpu') + 1:]
+    return bool(rel) and 'netsim' not in rel[:-1] \
+        and rel[-1] != 'transport.py'
+
+
+class _LayeringVisitor(ast.NodeVisitor):
+    def __init__(self, path, suppressions):
+        self.path = path
+        self.sup = suppressions
+        self.out = []
+
+    def _add(self, node, detail):
+        if not is_suppressed(self.sup, node.lineno, 'C110'):
+            self.out.append(Violation(
+                self.path, node.lineno, 'C110',
+                '%s: %s' % (detail, _C110_MSG)))
+
+    def visit_Import(self, node):
+        for a in node.names:
+            if a.name == 'socket' or a.name.startswith('socket.'):
+                self._add(node, 'import %s' % a.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == 'socket' or \
+                (node.module or '').startswith('socket.'):
+            self._add(node, 'from socket import')
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if _SOCK_METHOD_RE.match(func.attr) or \
+                    func.attr in _BYTE_FACTORIES:
+                self._add(node, '%s()' % func.attr)
+        self.generic_visit(node)
+
+
+def check_layering(path: str, text: str) -> list[Violation]:
+    if not layering_applies(path):
+        return []
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return []     # C100 reports the parse failure
+    v = _LayeringVisitor(path, parse_suppressions(text))
+    v.visit(tree)
+    return v.out
+
+
 def check_correctness(path: str, text: str) -> list[Violation]:
     try:
         tree = ast.parse(text, filename=path)
@@ -407,7 +482,8 @@ def lint_file(path: Path) -> list[Violation]:
     with open(path, encoding='utf-8', newline='') as f:
         text = f.read()
     return check_style(str(path), text) + \
-        check_correctness(str(path), text)
+        check_correctness(str(path), text) + \
+        check_layering(str(path), text)
 
 
 def iter_targets(args: list[str]):
