@@ -4,12 +4,30 @@ PYTHON ?= python3
 LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
-.PHONY: test check bench bench-host bench-sharded bench-control \
-	bench-health bench-profile profile dryrun coverage native ci docs \
-	docs-check fsm-graph scenarios scenarios-fast
+.PHONY: test check lint bench bench-host bench-sharded bench-control \
+	bench-health bench-profile profile dryrun coverage native \
+	native-sanitize ci docs docs-check fsm-graph scenarios \
+	scenarios-fast
 
 native:
 	$(PYTHON) native/build.py
+
+# ASan+UBSan gate for the C core (docs/static-analysis.md §Native
+# sanitizers): rebuild the extension instrumented, run the native
+# test suite with libasan preloaded (the interpreter is not
+# ASan-built, so the runtime must come in via LD_PRELOAD;
+# detect_leaks=0 because CPython's own arena allocations never
+# free at exit), then restore the normal -O2 build. --force on both
+# builds: setuptools only mtime-compares sources, a flags-only
+# change would silently reuse the stale object.
+native-sanitize:
+	CUEBALL_SANITIZE=1 $(PYTHON) native/build.py
+	LD_PRELOAD=$$(gcc -print-file-name=libasan.so) \
+	ASAN_OPTIONS=detect_leaks=0 \
+	UBSAN_OPTIONS=print_stacktrace=1:halt_on_error=1 \
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_native.py -q \
+		-p no:cacheprovider
+	CUEBALL_BUILD_FORCE=1 $(PYTHON) native/build.py
 
 test: native
 	$(PYTHON) -m pytest tests/ -x -q
@@ -29,13 +47,34 @@ scenarios-fast:
 	$(PYTHON) -m pytest tests/scenarios/ -q -m 'not slow'
 
 # The reference gates check on jsl + jsstyle (reference Makefile:33-41);
-# cblint is the vendored equivalent (tools/cblint.py) and cbfsm the
-# Moore-FSM analyzer (tools/cbfsm.py, docs/fsm-analysis.md); both FAIL
-# the build on any violation.
+# cblint is the vendored equivalent (tools/cblint.py), cbfsm the
+# Moore-FSM analyzer (tools/cbfsm.py, docs/fsm-analysis.md), and
+# cbflow the whole-program loop-affinity / determinism / blocking-
+# call analyzer (tools/cbflow.py, docs/static-analysis.md); all FAIL
+# the build on any violation. The --audit-suppressions pass (U001)
+# fails on any ignore-comment whose rule no longer fires, so the
+# suppression inventory can only shrink.
 check:
 	$(PYTHON) -m compileall -q cueball_tpu bin/cbresolve bench.py __graft_entry__.py
 	$(PYTHON) tools/cblint.py $(LINT_TARGETS)
 	$(PYTHON) tools/cbfsm.py cueball_tpu
+	$(PYTHON) tools/cbflow.py cueball_tpu
+	$(PYTHON) tools/cbflow.py --audit-suppressions $(LINT_TARGETS)
+
+# All three analyzers with NDJSON artifacts under .lint/ (one finding
+# per line, machine-diffable across runs). Exit status is the worst
+# of the three; artifacts are written either way.
+lint:
+	rm -rf .lint && mkdir -p .lint
+	status=0; \
+	$(PYTHON) tools/cblint.py --format=json $(LINT_TARGETS) \
+		> .lint/cblint.ndjson || status=1; \
+	$(PYTHON) tools/cbfsm.py --format=json cueball_tpu \
+		> .lint/cbfsm.ndjson || status=1; \
+	$(PYTHON) tools/cbflow.py --format=json cueball_tpu \
+		> .lint/cbflow.ndjson || status=1; \
+	cat .lint/cblint.ndjson .lint/cbfsm.ndjson .lint/cbflow.ndjson; \
+	exit $$status
 
 # Regenerate the committed FSM transition diagrams (docs/fsm/).
 fsm-graph:
@@ -47,6 +86,7 @@ fsm-graph:
 # what `make fsm-graph` would write.
 ci: native check docs-check
 	$(PYTHON) tools/cbfsm.py --check-graphs docs/fsm cueball_tpu
+	$(MAKE) native-sanitize
 	$(PYTHON) -m pytest tests/ -x -q -m 'not slow'
 	CUEBALL_NO_NATIVE=1 $(PYTHON) -m pytest tests/ -x -q -m 'not slow'
 	$(PYTHON) tools/cbprofile.py --smoke

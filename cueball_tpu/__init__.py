@@ -67,6 +67,7 @@ from .trace import (
 from .debug import (
     dump_fsm_histories,
     install_debug_handler,
+    LoopAffinityChecker,
     init_from_env as _debug_init_from_env,
 )
 from .transport import (
@@ -101,6 +102,7 @@ __all__ = [
     'HttpAgent', 'HttpsAgent',
     'pool_monitor', 'poolMonitor', 'enableStackTraces',
     'dump_fsm_histories', 'install_debug_handler',
+    'LoopAffinityChecker',
     'enable_tracing', 'disable_tracing', 'tracing_enabled',
     'trace_ring',
     'Transport', 'AsyncioTransport', 'FabricTransport',

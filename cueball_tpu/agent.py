@@ -364,7 +364,11 @@ class CueBallAgent(EventEmitter):
 
     def _make_checker(self, host: str):
         def checker(handle, socket):
-            asyncio.ensure_future(
+            # Fire-and-forget by design: the health check owns its
+            # whole lifecycle (it releases the claim handle on every
+            # path and reports failure through the FSM, never by
+            # raising), and the pool's checker callback is sync.
+            asyncio.ensure_future(  # cbflow: ignore=A004
                 self._check_socket(host, handle, socket))
         return checker
 

@@ -24,6 +24,14 @@ import sys
 
 from .resolver import (StaticIpResolver, config_for_ip_or_domain,
                        parse_ip_or_domain)
+from . import utils as mod_utils
+
+
+def _utc_now_iso() -> str:
+    """Timestamp for --follow output, read through the utils clock
+    seam so netsim-driven runs stay replayable (cbflow A003)."""
+    return datetime.datetime.fromtimestamp(
+        mod_utils.wall_time(), datetime.timezone.utc).isoformat()
 
 
 def parse_time_interval(s: str) -> int:
@@ -128,7 +136,7 @@ async def _amain(args) -> int:
         backends_seen[key] = backend
         if args.follow:
             print('%s added   %16s:%-5d (%s)' % (
-                datetime.datetime.now(datetime.timezone.utc).isoformat(),
+                _utc_now_iso(),
                 backend['address'], backend['port'], key))
         else:
             print('%-16s %5d %s' % (
@@ -138,7 +146,7 @@ async def _amain(args) -> int:
         old = backends_seen.pop(key, None)
         if args.follow and old is not None:
             print('%s removed %16s:%-5d (%s)' % (
-                datetime.datetime.now(datetime.timezone.utc).isoformat(),
+                _utc_now_iso(),
                 old['address'], old['port'], key))
 
     resolver.on('added', on_added)

@@ -28,12 +28,31 @@ import io
 import logging
 import os
 import signal
-import time
+import sys
+import threading
 
 from . import trace as mod_trace
 from . import utils as mod_utils
 
 _LOG = logging.getLogger('cueball.debug')
+
+# The licensed cross-thread marshal sites, as package-relative paths.
+# This tuple is the SINGLE source of truth for loop-affinity rule
+# A001: tools/cbflow.py parses it statically (any
+# call_soon_threadsafe / run_coroutine_threadsafe outside these
+# modules is a finding), and LoopAffinityChecker licenses the same
+# set at runtime (and records which sites were actually exercised, so
+# the conformance test can prove the registry is live, not
+# aspirational). Everything here is a deliberate cross-loop boundary:
+# the shard marshal layer (worker/proc/router), the signal-handler
+# dump deferral below, and the sync-client bridge.
+A001_MARSHAL_MODULES = (
+    'debug.py',
+    'integrations/httpx.py',
+    'shard/proc.py',
+    'shard/router.py',
+    'shard/worker.py',
+)
 
 
 def _fsm_line(tag: str, fsm) -> str:
@@ -107,7 +126,8 @@ def dump_fsm_histories(stream=None) -> str:
 
     buf = io.StringIO()
     buf.write('cueball FSM dump pid=%d t=%.3f stack_traces=%s\n' % (
-        os.getpid(), time.time(), mod_utils.stack_traces_enabled()))
+        os.getpid(), mod_utils.wall_time(),
+        mod_utils.stack_traces_enabled()))
     run_meta = mod_trace.get_run_metadata()
     if run_meta:
         # Inside a netsim scenario: name the replayable run this dump
@@ -236,6 +256,271 @@ def install_debug_handler(signum: int = signal.SIGUSR2):
 
 def uninstall_debug_handler(prev, signum: int = signal.SIGUSR2) -> None:
     signal.signal(signum, prev)
+
+
+def _package_rel(filename: str) -> str | None:
+    """Path relative to the innermost cueball_tpu package directory,
+    or None for frames outside the package (same scoping rule as
+    tools/cbflow.py's static pass)."""
+    parts = filename.replace('\\', '/').split('/')
+    if 'cueball_tpu' not in parts:
+        return None
+    idx = len(parts) - 1 - parts[::-1].index('cueball_tpu')
+    rel = parts[idx + 1:]
+    return '/'.join(rel) or None
+
+
+# Default entry points watched by LoopAffinityChecker.watch() when no
+# explicit method list is given. Deliberately NOT "every public
+# method": wrapping listener-registration methods would change bound-
+# method identity and break EventEmitter.remove_listener.
+_DEFAULT_WATCH = ('claim', 'claim_cb', 'claim_many', 'stop',
+                  'defer', 'wheel_arm', 'wheel_cancel')
+
+
+class LoopAffinityChecker:
+    """Opt-in runtime twin of cbflow rule A001.
+
+    While installed (``with LoopAffinityChecker() as lc:`` or
+    ``lc.install()`` / ``lc.uninstall()``):
+
+    - raw ``loop.call_soon``/``call_later``/``call_at`` from a thread
+      that is not the loop's running thread is recorded as an
+      ``off_thread_schedule`` violation (the bug class
+      call_soon_threadsafe exists to prevent);
+    - every ``call_soon_threadsafe`` / ``run_coroutine_threadsafe``
+      is attributed to the nearest cueball_tpu frame: licensed
+      modules (:data:`A001_MARSHAL_MODULES`) land in
+      :attr:`marshals_exercised`, any other package frame is an
+      ``unlicensed_marshal`` violation;
+    - every FSM transition in the process (via
+      ``fsm.add_transition_tracer``) must stay on the thread that
+      performed that FSM's first transition
+      (``off_thread_transition``);
+    - :meth:`watch` wraps declared entry points of pool / cset /
+      runq / shard-router objects so a direct off-thread call is
+      caught even when it never reaches the loop
+      (``off_thread_call``).
+
+    Violations accumulate as dicts in :attr:`violations`;
+    ``raise_on_violation=True`` turns the first one into an
+    AssertionError at the offending call site. The static/dynamic
+    conformance test (tests/test_cbflow_conformance.py) runs the
+    pool+cset+sharded soaks under this checker and asserts zero
+    violations with every licensed marshal module exercised.
+    """
+
+    def __init__(self, raise_on_violation: bool = False):
+        self.raise_on_violation = raise_on_violation
+        self.violations: list[dict] = []
+        self.marshals_exercised: set[str] = set()
+        self._installed = False
+        self._saved: dict = {}
+        self._watched: list = []
+        self._class_watch: dict = {}
+        self._instances: dict = {}
+        self._fsm_threads: dict = {}
+        self._tls = threading.local()
+
+    # -- recording --------------------------------------------------------
+
+    def _record(self, kind: str, **info) -> None:
+        info['kind'] = kind
+        self.violations.append(info)
+        if self.raise_on_violation:
+            raise AssertionError('loop-affinity violation: %r' % info)
+
+    def _site(self):
+        """Nearest cueball_tpu frame of the current call, skipping
+        this module's own wrappers: (relpath, lineno) or None."""
+        f = sys._getframe(2)
+        here = _package_rel(__file__)
+        while f is not None:
+            rel = _package_rel(f.f_code.co_filename)
+            if rel is not None and not (rel == here and
+                                        f.f_code.co_name.startswith(
+                                            '_lc_')):
+                return rel, f.f_lineno
+            f = f.f_back
+        return None
+
+    # -- loop patching ----------------------------------------------------
+
+    def install(self):
+        import asyncio
+
+        if self._installed:
+            return self
+        base = asyncio.base_events.BaseEventLoop
+        self._saved = {
+            'call_soon': base.call_soon,
+            'call_later': base.call_later,
+            'call_at': base.call_at,
+            'call_soon_threadsafe': base.call_soon_threadsafe,
+        }
+        checker = self
+
+        def _guarded(name, check):
+            orig = checker._saved[name]
+
+            def _lc_wrapper(loop, *args, **kwargs):
+                if not getattr(checker._tls, 'busy', False):
+                    checker._tls.busy = True
+                    try:
+                        check(loop)
+                    finally:
+                        checker._tls.busy = False
+                return orig(loop, *args, **kwargs)
+            _lc_wrapper.__name__ = '_lc_' + name
+            return _lc_wrapper
+
+        def _check_same_thread(loop):
+            owner = getattr(loop, '_thread_id', None)
+            if owner is not None and owner != threading.get_ident():
+                site = checker._site()
+                checker._record(
+                    'off_thread_schedule',
+                    site=site, loop=repr(loop),
+                    thread=threading.get_ident(), owner=owner)
+
+        def _check_marshal(loop):
+            site = checker._site()
+            if site is None:
+                return       # non-package caller: not ours to police
+            rel = site[0]
+            if rel in A001_MARSHAL_MODULES:
+                checker.marshals_exercised.add(rel)
+            else:
+                checker._record('unlicensed_marshal', site=site,
+                                thread=threading.get_ident())
+
+        base.call_soon = _guarded('call_soon', _check_same_thread)
+        base.call_later = _guarded('call_later', _check_same_thread)
+        base.call_at = _guarded('call_at', _check_same_thread)
+        base.call_soon_threadsafe = _guarded('call_soon_threadsafe',
+                                             _check_marshal)
+
+        from . import fsm as mod_fsm
+        self._tracer = self._on_transition
+        mod_fsm.add_transition_tracer(self._tracer)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        import asyncio
+
+        if not self._installed:
+            return
+        base = asyncio.base_events.BaseEventLoop
+        for name, orig in self._saved.items():
+            setattr(base, name, orig)
+        self._saved = {}
+        from . import fsm as mod_fsm
+        mod_fsm.remove_transition_tracer(self._tracer)
+        while self._watched:
+            obj, name, orig, had = self._watched.pop()
+            if had:
+                setattr(obj, name, orig)
+            else:
+                try:
+                    delattr(obj, name)
+                except AttributeError:
+                    pass
+        for (cls, name), orig in self._class_watch.items():
+            setattr(cls, name, orig)
+        self._class_watch.clear()
+        self._instances.clear()
+        # _fsm_threads is deliberately NOT cleared: it is the record
+        # of what the checker observed (the conformance test asserts
+        # on it after uninstall); its strong refs die with the
+        # checker object itself.
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- FSM transition affinity ------------------------------------------
+
+    def _on_transition(self, fsm, old_state, new_state) -> None:
+        # Keyed by id() with a strong ref alongside, so ids cannot be
+        # recycled while the checker is installed.
+        key = id(fsm)
+        tid = threading.get_ident()
+        rec = self._fsm_threads.get(key)
+        if rec is None:
+            self._fsm_threads[key] = (fsm, tid)
+        elif rec[1] != tid:
+            self._record('off_thread_transition',
+                         fsm=type(fsm).__name__,
+                         transition=(old_state, new_state),
+                         thread=tid, owner=rec[1])
+
+    # -- explicit object watching -----------------------------------------
+
+    def watch(self, obj, methods=None, tag: str | None = None):
+        """Wrap `obj`'s entry-point methods (default: the subset of
+        ``_DEFAULT_WATCH`` it actually has) so every call is checked
+        against the thread that made the FIRST call. Works on
+        modules (runq) and plain instances via instance attributes;
+        fully slotted instances (the FSM family: pool, cset, router
+        — no ``__dict__``) get a class-level wrapper that dispatches
+        on a per-instance registry, so unwatched siblings pay one
+        dict miss and nothing else."""
+        names = methods if methods is not None else [
+            n for n in _DEFAULT_WATCH
+            if callable(getattr(obj, n, None))]
+        owner: dict = {'thread': None}
+        label = tag or type(obj).__name__
+
+        def _check(name):
+            tid = threading.get_ident()
+            if owner['thread'] is None:
+                owner['thread'] = tid
+            elif owner['thread'] != tid:
+                self._record('off_thread_call', obj=label,
+                             method=name, thread=tid,
+                             owner=owner['thread'])
+
+        if getattr(obj, '__dict__', None) is not None:
+            def _make(name, orig):
+                def _lc_watched(*args, **kwargs):
+                    _check(name)
+                    return orig(*args, **kwargs)
+                _lc_watched.__name__ = '_lc_' + name
+                return _lc_watched
+
+            for name in names:
+                orig = getattr(obj, name)
+                had = name in vars(obj)
+                setattr(obj, name, _make(name, orig))
+                self._watched.append((obj, name, orig, had))
+            return obj
+
+        # Slotted instance: per-class wrapper, per-instance dispatch.
+        cls = type(obj)
+        self._instances[id(obj)] = (obj, set(names), _check)
+        for name in names:
+            key = (cls, name)
+            if key in self._class_watch:
+                continue
+            orig = getattr(cls, name)
+            self._class_watch[key] = orig
+
+            def _make_cls(name, orig):
+                def _lc_watched(inst, *args, **kwargs):
+                    rec = self._instances.get(id(inst))
+                    if rec is not None and name in rec[1]:
+                        rec[2](name)
+                    return orig(inst, *args, **kwargs)
+                _lc_watched.__name__ = '_lc_' + name
+                return _lc_watched
+
+            setattr(cls, name, _make_cls(name, orig))
+        return obj
 
 
 def init_from_env(env=os.environ) -> None:

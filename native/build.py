@@ -6,6 +6,17 @@ Compiles native/emitter.c into cueball_tpu/_cueball_native.*.so via
 setuptools. The framework runs identically (pure Python) when the
 extension is absent or CUEBALL_NO_NATIVE=1 is set; events.py / fsm.py
 pick the native core up automatically when present.
+
+Environment knobs:
+
+- ``CUEBALL_SANITIZE=1`` builds with ASan+UBSan
+  (-fsanitize=address,undefined) at -O1 with frame pointers, for
+  ``make native-sanitize``. The resulting extension must be loaded
+  with libasan preloaded (the Makefile target handles LD_PRELOAD),
+  since the interpreter itself is not ASan-built.
+- ``CUEBALL_BUILD_FORCE=1`` passes --force to build_ext. setuptools
+  only compares source/object mtimes, so a flags-only change (e.g.
+  sanitized -> normal) would otherwise silently reuse the stale .so.
 """
 
 import os
@@ -17,15 +28,29 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def main():
     os.chdir(ROOT)
     from setuptools import Extension, setup
-    sys.argv = [sys.argv[0], 'build_ext', '--inplace']
+    sanitize = os.environ.get('CUEBALL_SANITIZE', '') not in ('', '0')
+    force = os.environ.get('CUEBALL_BUILD_FORCE', '') not in ('', '0')
+    if sanitize:
+        cflags = ['-fsanitize=address,undefined',
+                  '-fno-omit-frame-pointer', '-g', '-O1']
+        ldflags = ['-fsanitize=address,undefined']
+    else:
+        cflags = ['-O2']
+        ldflags = []
+    script_args = ['build_ext', '--inplace']
+    if sanitize or force:
+        # Flags changed relative to whatever .o is cached: rebuild.
+        script_args.append('--force')
+    sys.argv = [sys.argv[0]] + script_args
     setup(
         name='cueball-tpu-native',
         ext_modules=[Extension(
             'cueball_tpu._cueball_native',
             sources=['native/emitter.c'],
-            extra_compile_args=['-O2'],
+            extra_compile_args=cflags,
+            extra_link_args=ldflags,
         )],
-        script_args=['build_ext', '--inplace'],
+        script_args=script_args,
     )
 
 

@@ -1,0 +1,444 @@
+"""Fixtures corpus for the cbflow whole-program analyzer: labelled
+true-positive and true-negative cases per rule code (A001-A005),
+suppression handling, the U001 unused-suppression audit, the NDJSON
+round trip, and the registry-drift pin against the runtime checker
+(tools/cbflow.py must license exactly what debug.LoopAffinityChecker
+licenses)."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, ROOT / 'tools' / ('%s.py' % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+cbflow = _load('cbflow')
+
+
+def _pkg(tmp_path, files: dict) -> str:
+    """Write a synthetic cueball_tpu package (the A-rules are scoped
+    to files under a cueball_tpu directory) and return its path."""
+    root = tmp_path / 'cueball_tpu'
+    for rel, text in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return str(root)
+
+
+def _run(tmp_path, files: dict):
+    _, violations = cbflow.analyze_paths([_pkg(tmp_path, files)])
+    return violations
+
+
+def _codes(tmp_path, files: dict) -> set:
+    return {v.code for v in _run(tmp_path, files)}
+
+
+# ---------------------------------------------------------------------------
+# A001: marshal licensing
+
+def test_a001_marshal_outside_licensed_modules(tmp_path):
+    vs = _run(tmp_path, {'foo.py': (
+        'def f(loop, cb):\n'
+        '    loop.call_soon_threadsafe(cb)\n')})
+    assert [(v.code, v.line) for v in vs] == [('A001', 2)]
+
+
+def test_a001_run_coroutine_threadsafe_flagged(tmp_path):
+    assert _codes(tmp_path, {'foo.py': (
+        'import asyncio\n\n\n'
+        'def f(loop, coro):\n'
+        '    asyncio.run_coroutine_threadsafe(coro, loop)\n')}) \
+        == {'A001'}
+
+
+def test_a001_licensed_module_clean(tmp_path):
+    assert _codes(tmp_path, {'shard/worker.py': (
+        'def f(loop, cb):\n'
+        '    loop.call_soon_threadsafe(cb)\n')}) == set()
+
+
+def test_a001_registry_read_from_debug_module(tmp_path):
+    # A scanned debug.py overrides the built-in default registry.
+    files = {
+        'debug.py': "A001_MARSHAL_MODULES = ('custom.py',)\n",
+        'custom.py': ('def f(loop, cb):\n'
+                      '    loop.call_soon_threadsafe(cb)\n'),
+        'shard/worker.py': ('def f(loop, cb):\n'
+                            '    loop.call_soon_threadsafe(cb)\n'),
+    }
+    vs = _run(tmp_path, files)
+    assert {(Path(v.path).name, v.code) for v in vs} \
+        == {('worker.py', 'A001')}
+
+
+def test_a001_registry_matches_runtime_checker():
+    # The static default and the runtime checker's registry are the
+    # same tuple (debug.py is the single source of truth); a drift
+    # here would let the two halves license different sites.
+    import cueball_tpu.debug as dbg
+    assert cbflow.DEFAULT_MARSHAL_MODULES == dbg.A001_MARSHAL_MODULES
+    program, _ = cbflow.analyze_paths([str(ROOT / 'cueball_tpu')])
+    assert program.marshal_modules == dbg.A001_MARSHAL_MODULES
+
+
+# ---------------------------------------------------------------------------
+# A002: blocking calls on the loop
+
+def test_a002_time_sleep_in_async_def(tmp_path):
+    vs = _run(tmp_path, {'foo.py': (
+        'import time\n\n\n'
+        'async def f():\n'
+        '    time.sleep(1)\n')})
+    assert [(v.code, v.line) for v in vs] == [('A002', 5)]
+
+
+def test_a002_from_import_alias(tmp_path):
+    assert _codes(tmp_path, {'foo.py': (
+        'from time import sleep\n\n\n'
+        'async def f():\n'
+        '    sleep(1)\n')}) == {'A002'}
+
+
+def test_a002_open_and_subprocess_in_async(tmp_path):
+    vs = _run(tmp_path, {'foo.py': (
+        'import subprocess\n\n\n'
+        'async def f():\n'
+        '    data = open("/etc/hosts").read()\n'
+        '    subprocess.run(["true"])\n'
+        '    return data\n')})
+    assert [v.line for v in vs if v.code == 'A002'] == [5, 6]
+
+
+def test_a002_state_entry_and_nested_callback(tmp_path):
+    # State entries run on the loop; so do the callbacks they define
+    # (gated handlers), so the nested sync def stays sensitive.
+    vs = _run(tmp_path, {'foo.py': (
+        'import time\n\n\n'
+        'class M:\n'
+        '    def state_slow(self, s):\n'
+        '        time.sleep(1)\n\n'
+        '        def cb():\n'
+        '            time.sleep(2)\n'
+        '        s.on(self, "x", cb)\n')})
+    assert [v.line for v in vs if v.code == 'A002'] == [6, 9]
+
+
+def test_a002_sync_function_clean(tmp_path):
+    assert _codes(tmp_path, {'foo.py': (
+        'import time\n\n\n'
+        'def f():\n'
+        '    time.sleep(1)\n')}) == set()
+
+
+def test_a002_nested_sync_def_in_async_clean(tmp_path):
+    # A sync def nested in an async def is a callback definition, not
+    # loop-time execution (cbfsm F007 scoping).
+    assert _codes(tmp_path, {'foo.py': (
+        'import time\n\n\n'
+        'async def f(emitter):\n'
+        '    def on_done():\n'
+        '        time.sleep(0.1)\n'
+        '    emitter.on("done", on_done)\n')}) == set()
+
+
+# ---------------------------------------------------------------------------
+# A003: determinism seams
+
+def test_a003_clock_and_rng_reads(tmp_path):
+    vs = _run(tmp_path, {'foo.py': (
+        'import os\n'
+        'import random\n'
+        'import time\n'
+        'import uuid\n\n\n'
+        'def f():\n'
+        '    return (time.time(), time.monotonic(),\n'
+        '            random.random(), os.urandom(8), uuid.uuid4())\n')})
+    assert [v.code for v in vs] == ['A003'] * 5
+
+
+def test_a003_datetime_now_variants(tmp_path):
+    vs = _run(tmp_path, {'foo.py': (
+        'import datetime\n'
+        'from datetime import datetime as dt\n\n\n'
+        'def f():\n'
+        '    a = datetime.datetime.now()\n'
+        '    b = dt.utcnow()\n'
+        '    return a, b\n')})
+    assert [v.line for v in vs if v.code == 'A003'] == [6, 7]
+
+
+def test_a003_utils_is_the_licensed_seam(tmp_path):
+    assert _codes(tmp_path, {'utils.py': (
+        'import time\n\n\n'
+        'def wall_time():\n'
+        '    return time.time()\n')}) == set()
+
+
+def test_a003_seeded_random_stream_exempt(tmp_path):
+    # Constructing a seeded stream IS the determinism mechanism.
+    assert _codes(tmp_path, {'foo.py': (
+        'import random\n\n\n'
+        'def f(seed):\n'
+        '    return random.Random(seed)\n')}) == set()
+
+
+# ---------------------------------------------------------------------------
+# A004: fire-and-forget coroutines
+
+def test_a004_bare_coroutine_call(tmp_path):
+    vs = _run(tmp_path, {'foo.py': (
+        'async def work():\n'
+        '    pass\n\n\n'
+        'def kick():\n'
+        '    work()\n')})
+    assert [(v.code, v.line) for v in vs] == [('A004', 6)]
+
+
+def test_a004_self_method_coroutine(tmp_path):
+    assert _codes(tmp_path, {'foo.py': (
+        'class C:\n'
+        '    async def work(self):\n'
+        '        pass\n\n'
+        '    def kick(self):\n'
+        '        self.work()\n')}) == {'A004'}
+
+
+def test_a004_cross_module_import(tmp_path):
+    # Whole-program: the coroutine-ness of `work` is only knowable by
+    # also parsing the module it is imported from.
+    vs = _run(tmp_path, {
+        'a.py': 'async def work():\n    pass\n',
+        'b.py': ('from .a import work\n\n\n'
+                 'def kick():\n'
+                 '    work()\n'),
+    })
+    assert {(Path(v.path).name, v.code) for v in vs} \
+        == {('b.py', 'A004')}
+
+
+def test_a004_dropped_task(tmp_path):
+    assert _codes(tmp_path, {'foo.py': (
+        'import asyncio\n\n\n'
+        'async def work():\n'
+        '    pass\n\n\n'
+        'def kick(loop):\n'
+        '    asyncio.ensure_future(work())\n')}) == {'A004'}
+
+
+def test_a004_awaited_and_retained_clean(tmp_path):
+    assert _codes(tmp_path, {'foo.py': (
+        'import asyncio\n\n\n'
+        'async def work():\n'
+        '    pass\n\n\n'
+        'async def kick():\n'
+        '    await work()\n'
+        '    t = asyncio.ensure_future(work())\n'
+        '    return t\n')}) == set()
+
+
+# ---------------------------------------------------------------------------
+# A005: phase-seam coverage
+
+_PROFILE = ("_SEAM_MODULES = ('cueball_tpu.hot',)\n")
+
+
+def test_a005_registered_module_missing_prof(tmp_path):
+    vs = _run(tmp_path, {
+        'profile.py': _PROFILE,
+        'hot.py': 'def claim():\n    pass\n',
+    })
+    assert [(Path(v.path).name, v.code) for v in vs] \
+        == [('profile.py', 'A005')]
+
+
+def test_a005_prof_defined_but_never_read(tmp_path):
+    vs = _run(tmp_path, {
+        'profile.py': _PROFILE,
+        'hot.py': '_prof = None\n\n\ndef claim():\n    pass\n',
+    })
+    assert [(Path(v.path).name, v.code, v.line) for v in vs] \
+        == [('hot.py', 'A005', 1)]
+
+
+def test_a005_prof_module_missing_from_registry(tmp_path):
+    vs = _run(tmp_path, {
+        'profile.py': _PROFILE,
+        'hot.py': ('_prof = None\n\n\n'
+                   'def claim():\n'
+                   '    prof = _prof\n'
+                   '    return prof\n'),
+        'cold.py': ('_prof = None\n\n\n'
+                    'def pump():\n'
+                    '    prof = _prof\n'
+                    '    return prof\n'),
+    })
+    assert [(Path(v.path).name, v.code) for v in vs] \
+        == [('cold.py', 'A005')]
+
+
+def test_a005_push_without_finally_pop(tmp_path):
+    vs = _run(tmp_path, {
+        'profile.py': _PROFILE,
+        'hot.py': ('_prof = None\n\n\n'
+                   'def claim(prof):\n'
+                   '    x = _prof\n'
+                   '    tok = prof.push_phase("claim")\n'
+                   '    prof.pop_phase(tok)\n'
+                   '    return x\n'),
+    })
+    assert [(v.code, v.line) for v in vs] == [('A005', 6)]
+
+
+def test_a005_push_with_finally_pop_clean(tmp_path):
+    assert _codes(tmp_path, {
+        'profile.py': _PROFILE,
+        'hot.py': ('_prof = None\n\n\n'
+                   'def claim(prof):\n'
+                   '    x = _prof\n'
+                   '    tok = prof.push_phase("claim")\n'
+                   '    try:\n'
+                   '        return x\n'
+                   '    finally:\n'
+                   '        prof.pop_phase(tok)\n'),
+    }) == set()
+
+
+def test_a005_real_package_registry_is_total():
+    # The actual repo must satisfy its own seam-coverage rule.
+    _, vs = cbflow.analyze_paths([str(ROOT / 'cueball_tpu')])
+    assert [v for v in vs if v.code == 'A005'] == []
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+
+def test_suppression_per_code(tmp_path):
+    assert _codes(tmp_path, {'foo.py': (
+        'import time\n\n\n'
+        'def f():\n'
+        '    # seeded corpus: justified determinism escape\n'
+        '    return time.time()  # cbflow: ignore=A003\n')}) == set()
+
+
+def test_suppression_blanket(tmp_path):
+    assert _codes(tmp_path, {'foo.py': (
+        'import time\n\n\n'
+        'def f():\n'
+        '    return time.time()  # cbflow: ignore\n')}) == set()
+
+
+def test_suppression_wrong_code_still_fires(tmp_path):
+    assert _codes(tmp_path, {'foo.py': (
+        'import time\n\n\n'
+        'def f():\n'
+        '    return time.time()  # cbflow: ignore=A001\n')}) \
+        == {'A003'}
+
+
+# ---------------------------------------------------------------------------
+# U001: unused-suppression audit
+
+def test_u001_live_suppression_passes(tmp_path):
+    pkg = _pkg(tmp_path, {'foo.py': (
+        'import time\n\n\n'
+        'def f():\n'
+        '    return time.time()  # cbflow: ignore=A003\n')})
+    assert cbflow.audit_suppressions([pkg]) == []
+
+
+def test_u001_unused_suppression_fails(tmp_path):
+    pkg = _pkg(tmp_path, {'foo.py': (
+        'x = 1  # cbflow: ignore=A003\n')})
+    vs = cbflow.audit_suppressions([pkg])
+    assert [(v.code, v.line) for v in vs] == [('U001', 1)]
+
+
+def test_u001_blanket_with_no_live_rule_fails(tmp_path):
+    pkg = _pkg(tmp_path, {'foo.py': (
+        'x = 1  # cbflow: ignore\n')})
+    assert [v.code for v in cbflow.audit_suppressions([pkg])] \
+        == ['U001']
+
+
+def test_u001_covers_cblint_and_cbfsm_comments(tmp_path):
+    # The audit is shared: a dead cblint ignore fails it too.
+    pkg = _pkg(tmp_path, {'foo.py': (
+        'x = 1  # cblint: ignore=S001\n')})
+    vs = cbflow.audit_suppressions([pkg])
+    assert [(v.code, v.line) for v in vs] == [('U001', 1)]
+    assert 'cblint' in vs[0].msg
+
+
+def test_u001_string_literals_are_not_suppressions(tmp_path):
+    # Only real COMMENT tokens count: docs/fixtures that merely
+    # contain suppression-shaped text must not be audited.
+    pkg = _pkg(tmp_path, {'foo.py': (
+        'S = "# cbflow: ignore=A003"\n')})
+    assert cbflow.audit_suppressions([pkg]) == []
+
+
+def test_u001_repo_inventory_is_clean():
+    targets = [str(ROOT / 'cueball_tpu'), str(ROOT / 'tools')]
+    assert cbflow.audit_suppressions(targets) == []
+
+
+# ---------------------------------------------------------------------------
+# NDJSON round trip + CLI contract
+
+def test_ndjson_round_trip(tmp_path, capsys):
+    pkg = _pkg(tmp_path, {'foo.py': (
+        'import time\n\n\n'
+        'async def f():\n'
+        '    time.sleep(1)\n'
+        '    return time.time()\n')})
+    rc = cbflow.main(['--format=json', pkg])
+    assert rc == 1
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    parsed = [json.loads(ln) for ln in lines]
+    assert [(p['code'], p['line']) for p in parsed] \
+        == [('A002', 5), ('A003', 6)]
+    assert all(set(p) == {'path', 'line', 'code', 'msg'}
+               for p in parsed)
+
+
+def test_cli_clean_exit_zero(tmp_path, capsys):
+    pkg = _pkg(tmp_path, {'foo.py': 'x = 1\n'})
+    assert cbflow.main([pkg]) == 0
+    assert 'clean' in capsys.readouterr().out
+
+
+def test_cli_no_targets_exit_two():
+    assert cbflow.main(['--format=json']) == 2
+
+
+def test_files_outside_package_scope_ignored(tmp_path):
+    # tests/, bench.py etc. are lint targets for U001 but not for the
+    # A-rules: only package files are in scope.
+    p = tmp_path / 'standalone.py'
+    p.write_text('import time\n\n\nasync def f():\n    time.sleep(1)\n')
+    _, vs = cbflow.analyze_paths([str(p)])
+    assert vs == []
+
+
+def test_real_package_is_clean():
+    # The gate `make check` enforces, pinned as a test: zero
+    # unsuppressed findings on the shipped package.
+    _, vs = cbflow.analyze_paths([str(ROOT / 'cueball_tpu')])
+    assert vs == []
+
+
+if __name__ == '__main__':
+    import sys
+    sys.exit(pytest.main([__file__, '-q']))

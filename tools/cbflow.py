@@ -1,0 +1,875 @@
+#!/usr/bin/env python3
+"""cbflow — whole-program loop-affinity / determinism / blocking-call
+analyzer for cueball_tpu.
+
+cblint's C110 fences the *syntactic* half of the transport layering
+(who may open sockets); cbfsm proves the Moore machines well-formed.
+cbflow enforces the *semantic* half of the concurrency discipline —
+who may touch what, from which loop, reading which clock — statically,
+before the native data plane (ROADMAP item 2) makes cross-loop races
+and hidden blocking calls unreproducible at runtime. It is a
+whole-program pass: it parses every module under ``cueball_tpu/``
+first, builds a cross-file index (async callables per module/class,
+import aliasing, the ``profile._SEAM_MODULES`` phase-seam registry and
+the ``debug.A001_MARSHAL_MODULES`` marshal-site registry), then checks
+each file against that index.
+
+Rules (each A-code has labelled fixture cases in
+tests/test_cbflow.py):
+
+- A001  loop-affinity marshal licensing — the cross-thread marshal
+        primitives (``call_soon_threadsafe``,
+        ``asyncio.run_coroutine_threadsafe``) may appear ONLY in the
+        declared marshal modules (the ``A001_MARSHAL_MODULES`` tuple
+        in cueball_tpu/debug.py: the shard cross-loop layer, the
+        signal-handler dump deferral, and the sync-client bridge).
+        Anywhere else a cross-thread marshal is a loop-affinity hole:
+        the object it targets is owned by exactly one loop and must be
+        reached through the shard router, not ad-hoc marshalling. The
+        dynamic twin is ``debug.LoopAffinityChecker``, which licenses
+        the same registry at runtime and additionally catches raw
+        off-thread ``call_soon``/``call_later``.
+- A002  blocking call on the event loop — ``time.sleep``, sync socket
+        helpers (``socket.getaddrinfo``/``create_connection``/...),
+        ``subprocess``/``os.system``, ``select.select`` or builtin
+        ``open`` inside an ``async def`` body (own scope, like cbfsm
+        F007) or anywhere in a ``state_<name>`` FSM entry subtree
+        (entries and their gated callbacks run on the loop).
+- A003  determinism seam — direct ``time.time()``/``monotonic()``/
+        ``perf_counter()``, ``datetime...now()/utcnow()/today()``,
+        ``random.*`` module calls, ``os.urandom`` or
+        ``uuid.uuid1/uuid4`` outside cueball_tpu/utils.py (the
+        ``get_clock``/``get_rng`` seam definition). Netsim
+        byte-identical replay depends on every time read and random
+        draw flowing through the seams; ``random.Random(seed)``
+        construction is deterministic and exempt.
+- A004  fire-and-forget coroutine / dropped task — an expression
+        statement that calls a known ``async def`` (same module, same
+        class via ``self.``, or imported from another cueball_tpu
+        module — resolved whole-program) without ``await``, or that
+        drops the result of ``asyncio.ensure_future``/
+        ``create_task``: the coroutine never runs, or its exceptions
+        vanish with the unreferenced task.
+- A005  phase-seam coverage — the PR-11 ledger identity
+        (sum(phases) == wall) is only total if the claim-hot-path
+        modules carry their ``_prof`` seam: every module named in
+        ``profile._SEAM_MODULES`` must define a module-level
+        ``_prof`` and read it; every module defining ``_prof`` must
+        be in the registry (else the sampler never binds it); and
+        every function pushing a phase must pop it in a ``finally``.
+- U001  unused suppression (``--audit-suppressions``) — a
+        ``# cbflint/cbfsm/cbflow: ignore`` comment whose rule no
+        longer fires on its line fails the build, so the suppression
+        inventory can only shrink. Comments are discovered via the
+        tokenizer (string literals that merely look like suppressions
+        don't count).
+
+Suppress a single line with a trailing ``# cbflow: ignore`` or the
+per-code form ``# cbflow: ignore=A001,A003`` (same contract as
+cblint/cbfsm); every committed suppression must carry a justification
+comment and survives only while its rule still fires (U001).
+
+Usage:
+    cbflow.py [--format=json] paths...
+    cbflow.py --audit-suppressions [--format=json] paths...
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import sys
+import tokenize
+from pathlib import Path
+
+CODES = {
+    'A001': 'cross-thread marshal outside the licensed marshal '
+            'modules',
+    'A002': 'blocking call on the event loop',
+    'A003': 'raw clock/RNG read outside the utils seams',
+    'A004': 'fire-and-forget coroutine / dropped task',
+    'A005': 'phase-seam coverage break',
+    'U001': 'suppression whose rule never fires',
+}
+
+_SUPPRESS_RE = re.compile(
+    r'#\s*cbflow:\s*ignore(?:=([A-Z0-9,\s]+))?\s*$')
+
+# Fallback marshal-site registry, used only when the scanned tree has
+# no debug.py declaring A001_MARSHAL_MODULES (the canonical copy lives
+# next to the runtime checker in cueball_tpu/debug.py so the static
+# and dynamic halves cannot drift; tests/test_cbflow.py pins the
+# extraction).
+DEFAULT_MARSHAL_MODULES = (
+    'debug.py',
+    'integrations/httpx.py',
+    'shard/proc.py',
+    'shard/router.py',
+    'shard/worker.py',
+)
+
+# The A001 marshal primitives: everything that moves a callable onto
+# another loop's thread.
+_MARSHAL_ATTRS = {'call_soon_threadsafe', 'run_coroutine_threadsafe'}
+
+# A002: known blocking entry points, by module. Receiver-typed calls
+# (``sock.recv``, ``fh.read``) are unknowable without inference and
+# stay out — C110 already fences raw sockets to the transport seam.
+_BLOCKING_CALLS = {
+    'time': {'sleep'},
+    'subprocess': {'run', 'call', 'check_call', 'check_output',
+                   'Popen', 'getoutput', 'getstatusoutput'},
+    'os': {'system', 'popen', 'wait', 'waitpid'},
+    'socket': {'create_connection', 'getaddrinfo', 'gethostbyname',
+               'gethostbyname_ex', 'gethostbyaddr', 'getfqdn',
+               'getnameinfo'},
+    'select': {'select', 'poll'},
+}
+_BLOCKING_BUILTINS = {'open'}
+
+# A003: nondeterministic reads, by module. `random.Random` is exempt
+# (constructing a seeded stream is how netsim pins determinism);
+# `SystemRandom` is not (it reads os.urandom per draw).
+_CLOCK_CALLS = {
+    'time': {'time', 'monotonic', 'perf_counter', 'process_time',
+             'thread_time', 'time_ns', 'monotonic_ns',
+             'perf_counter_ns'},
+    'os': {'urandom'},
+    'uuid': {'uuid1', 'uuid4'},
+}
+_RANDOM_EXEMPT = {'Random'}
+_DATETIME_READS = {'now', 'utcnow', 'today'}
+
+# A003 licensed module: the seam definition itself.
+_SEAM_DEFINITION = 'utils.py'
+
+# A004 task factories whose dropped result loses exceptions.
+_TASK_FACTORY_ATTRS = {'ensure_future', 'create_task'}
+
+
+class Violation:
+    def __init__(self, path, line, code, msg):
+        self.path = path
+        self.line = line
+        self.code = code
+        self.msg = msg
+
+    def __str__(self):
+        return '%s:%d: %s %s' % (self.path, self.line, self.code,
+                                 self.msg)
+
+    def to_json(self):
+        return {'path': str(self.path), 'line': self.line,
+                'code': self.code, 'msg': self.msg}
+
+
+def parse_suppressions(text: str) -> dict:
+    """Map line number -> None (all codes) or a set of codes, for
+    lines carrying a trailing ``# cbflow: ignore[=A001,...]``."""
+    out: dict = {}
+    for i, line in enumerate(text.split('\n'), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m is None:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            out[i] = {c.strip() for c in m.group(1).split(',')
+                      if c.strip()}
+    return out
+
+
+def is_suppressed(supmap: dict, line: int, code: str) -> bool:
+    if line not in supmap:
+        return False
+    codes = supmap[line]
+    return codes is None or code in codes
+
+
+def package_rel(path: str) -> str | None:
+    """Posix path relative to the innermost ``cueball_tpu`` package
+    directory, or None when the file is outside any (the A-rules are
+    scoped to the package proper, like cblint C110)."""
+    parts = Path(path).parts
+    if 'cueball_tpu' not in parts:
+        return None
+    idx = len(parts) - 1 - parts[::-1].index('cueball_tpu')
+    rel = parts[idx + 1:]
+    if not rel:
+        return None
+    return '/'.join(rel)
+
+
+class ModuleInfo:
+    """One parsed module plus its cross-file facts."""
+
+    def __init__(self, path: str, rel: str, tree, text: str):
+        self.path = path
+        self.rel = rel
+        self.tree = tree
+        self.text = text
+        self.sup = parse_suppressions(text)
+        # local alias -> stdlib/external module dotted name
+        self.import_alias: dict[str, str] = {}
+        # local name -> (source module dotted name, original name)
+        self.from_imports: dict[str, tuple[str, str]] = {}
+        self.async_defs: set[str] = set()
+        self.class_async: dict[str, set[str]] = {}
+        self.prof_def_line: int | None = None
+        self.prof_read = False
+
+    def module_of(self, name: str) -> str | None:
+        """The dotted module an alias refers to, if `name` was bound
+        by a plain ``import`` (possibly ``as``)."""
+        return self.import_alias.get(name)
+
+
+def _rel_to_dotted(rel: str) -> str:
+    """'shard/worker.py' -> 'cueball_tpu.shard.worker'."""
+    mod = rel[:-3] if rel.endswith('.py') else rel
+    mod = mod.replace('/', '.')
+    if mod.endswith('.__init__'):
+        mod = mod[:-len('.__init__')]
+    return 'cueball_tpu' + ('.' + mod if mod else '')
+
+
+def _dotted_to_rel(dotted: str) -> str | None:
+    """'cueball_tpu.shard.worker' -> 'shard/worker.py' (None outside
+    the package)."""
+    parts = dotted.split('.')
+    if 'cueball_tpu' not in parts:
+        return None
+    sub = parts[parts.index('cueball_tpu') + 1:]
+    if not sub:
+        return '__init__.py'
+    return '/'.join(sub) + '.py'
+
+
+def _resolve_from(rel: str, node: ast.ImportFrom) -> str | None:
+    """Absolute dotted name of a ``from X import ...`` source, with
+    relative imports resolved against `rel` inside the package."""
+    if node.level == 0:
+        return node.module
+    base = _rel_to_dotted(rel).split('.')
+    # level=1 strips the module itself; each extra level one package.
+    base = base[:-node.level]
+    if node.module:
+        base = base + node.module.split('.')
+    return '.'.join(base) if base else None
+
+
+def _index_module(path: str, rel: str, text: str) -> ModuleInfo | None:
+    try:
+        tree = ast.parse(text, filename=path)
+    except SyntaxError:
+        return None          # cblint C100 owns reporting parse errors
+    info = ModuleInfo(path, rel, tree, text)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                info.import_alias[a.asname or a.name.split('.')[0]] \
+                    = a.name
+        elif isinstance(node, ast.ImportFrom):
+            src = _resolve_from(rel, node)
+            if src is None:
+                continue
+            for a in node.names:
+                if a.name != '*':
+                    info.from_imports[a.asname or a.name] = (src,
+                                                             a.name)
+    for node in info.tree.body:
+        if isinstance(node, ast.AsyncFunctionDef):
+            info.async_defs.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            meths = {f.name for f in node.body
+                     if isinstance(f, ast.AsyncFunctionDef)}
+            if meths:
+                info.class_async[node.name] = meths
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == '_prof':
+                    info.prof_def_line = node.lineno
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Name) and node.id == '_prof' and \
+                isinstance(node.ctx, ast.Load):
+            info.prof_read = True
+    return info
+
+
+class Program:
+    """The whole-program index over one analyzer invocation."""
+
+    def __init__(self):
+        self.files: dict[str, ModuleInfo] = {}   # rel -> info
+        self.marshal_modules = DEFAULT_MARSHAL_MODULES
+        self.seam_registry: list[tuple[str, int]] | None = None
+        self.seam_registry_rel: str | None = None
+
+    def add(self, info: ModuleInfo) -> None:
+        self.files[info.rel] = info
+
+    def finish(self) -> None:
+        dbg = self.files.get('debug.py')
+        if dbg is not None:
+            mods = _extract_str_tuple(dbg.tree, 'A001_MARSHAL_MODULES')
+            if mods:
+                self.marshal_modules = tuple(s for s, _ in mods)
+        prof = self.files.get('profile.py')
+        if prof is not None:
+            reg = _extract_str_tuple(prof.tree, '_SEAM_MODULES')
+            if reg is not None:
+                self.seam_registry = reg
+                self.seam_registry_rel = 'profile.py'
+
+    def is_async_callable(self, info: ModuleInfo, name: str) -> bool:
+        """Does bare `name` in `info` refer to an ``async def`` —
+        local, or imported from another scanned cueball_tpu module?"""
+        if name in info.async_defs:
+            return True
+        imp = info.from_imports.get(name)
+        if imp is None:
+            return False
+        src_rel = _dotted_to_rel(imp[0])
+        if src_rel is None or src_rel not in self.files:
+            return False
+        return imp[1] in self.files[src_rel].async_defs
+
+
+def _extract_str_tuple(tree, name: str):
+    """Module-level ``NAME = ('a', 'b', ...)`` -> [(value, lineno)],
+    or None when no such assignment exists."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == name and \
+                    isinstance(node.value, (ast.Tuple, ast.List)):
+                out = []
+                for el in node.value.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        out.append((el.value, el.lineno))
+                return out
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Per-file rule pass
+
+
+class _FlowVisitor(ast.NodeVisitor):
+    """A001-A004 over one module, using the program index."""
+
+    def __init__(self, program: Program, info: ModuleInfo, collect):
+        self.program = program
+        self.info = info
+        self.collect = collect
+        self.class_stack: list[str] = []
+        # Each element: 'async' (inside async def own scope), 'sync'
+        # (a nested sync def re-enters callback land), or 'state'
+        # (inside a state_<name> entry subtree: stays blocking-
+        # sensitive through nested defs).
+        self.func_stack: list[str] = []
+
+    def _add(self, node, code, msg):
+        if not is_suppressed(self.info.sup, node.lineno, code):
+            self.collect(Violation(self.info.path, node.lineno, code,
+                                   msg))
+
+    # -- context tracking -------------------------------------------------
+
+    def visit_ClassDef(self, node):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _is_state_entry(self, node) -> bool:
+        return bool(self.class_stack) and \
+            node.name.startswith('state_') and \
+            len(node.args.args) >= 2
+
+    def visit_FunctionDef(self, node):
+        if self._is_state_entry(node) or \
+                (self.func_stack and self.func_stack[-1] == 'state'):
+            # State entries and everything defined inside them (gated
+            # callbacks) run on the loop.
+            self.func_stack.append('state')
+        else:
+            self.func_stack.append('sync')
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node):
+        if self.func_stack and self.func_stack[-1] == 'state':
+            self.func_stack.append('state')
+        else:
+            self.func_stack.append('async')
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_Lambda(self, node):
+        kind = 'state' if (self.func_stack and
+                           self.func_stack[-1] == 'state') else 'sync'
+        self.func_stack.append(kind)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def _on_loop(self) -> bool:
+        """Blocking-sensitive context: an async body's own scope, or
+        anywhere in a state-entry subtree."""
+        return bool(self.func_stack) and \
+            self.func_stack[-1] in ('async', 'state')
+
+    # -- statements -------------------------------------------------------
+
+    def visit_Expr(self, node):
+        call = node.value
+        if isinstance(call, ast.Call):
+            self._check_dropped(call)
+        self.generic_visit(node)
+
+    def _check_dropped(self, call: ast.Call) -> None:
+        """A004: the call's value is discarded (bare Expr)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if self.program.is_async_callable(self.info, f.id):
+                self._add(call, 'A004',
+                          'coroutine "%s(...)" is created but never '
+                          'awaited (it will not run)' % f.id)
+            return
+        if not isinstance(f, ast.Attribute):
+            return
+        if f.attr in _TASK_FACTORY_ATTRS:
+            self._add(call, 'A004',
+                      'task from "%s(...)" is dropped: exceptions '
+                      'vanish with the unreferenced task; keep a '
+                      'reference or await it' % f.attr)
+            return
+        if isinstance(f.value, ast.Name) and f.value.id == 'self' \
+                and self.class_stack:
+            meths = self.info.class_async.get(self.class_stack[-1],
+                                              set())
+            if f.attr in meths:
+                self._add(call, 'A004',
+                          'coroutine "self.%s(...)" is created but '
+                          'never awaited (it will not run)' % f.attr)
+
+    # -- calls ------------------------------------------------------------
+
+    def _dotted_module(self, node) -> str | None:
+        """The stdlib module a call receiver resolves to via plain
+        import aliasing ('time', 'os.path', ...)."""
+        if isinstance(node, ast.Name):
+            return self.info.module_of(node.id)
+        return None
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            self._check_marshal(node, f)
+            self._check_blocking_attr(node, f)
+            self._check_clock_attr(node, f)
+        elif isinstance(f, ast.Name):
+            self._check_blocking_name(node, f)
+            self._check_clock_name(node, f)
+        self.generic_visit(node)
+
+    def _check_marshal(self, node, f) -> None:
+        if f.attr not in _MARSHAL_ATTRS:
+            return
+        if self.info.rel in self.program.marshal_modules:
+            return
+        self._add(node, 'A001',
+                  '"%s(...)" outside the licensed marshal modules '
+                  '(%s): route cross-loop work through the shard '
+                  'router or a declared marshal site' % (
+                      f.attr,
+                      ', '.join(self.program.marshal_modules)))
+
+    def _check_blocking_attr(self, node, f) -> None:
+        if not self._on_loop():
+            return
+        mod = self._dotted_module(f.value)
+        if mod in _BLOCKING_CALLS and f.attr in _BLOCKING_CALLS[mod]:
+            self._add(node, 'A002',
+                      'blocking "%s.%s(...)" %s stalls every claim '
+                      'on this loop' % (mod, f.attr, self._where()))
+
+    def _check_blocking_name(self, node, f) -> None:
+        if not self._on_loop():
+            return
+        if f.id in _BLOCKING_BUILTINS:
+            self._add(node, 'A002',
+                      'blocking "%s(...)" %s stalls every claim on '
+                      'this loop' % (f.id, self._where()))
+            return
+        imp = self.info.from_imports.get(f.id)
+        if imp is not None and imp[0] in _BLOCKING_CALLS and \
+                imp[1] in _BLOCKING_CALLS[imp[0]]:
+            self._add(node, 'A002',
+                      'blocking "%s(...)" (%s.%s) %s stalls every '
+                      'claim on this loop' % (f.id, imp[0], imp[1],
+                                              self._where()))
+
+    def _where(self) -> str:
+        return 'in an FSM state entry' \
+            if self.func_stack and self.func_stack[-1] == 'state' \
+            else 'in an async def body'
+
+    def _check_clock_attr(self, node, f) -> None:
+        if self.info.rel == _SEAM_DEFINITION:
+            return
+        mod = self._dotted_module(f.value)
+        if mod in _CLOCK_CALLS and f.attr in _CLOCK_CALLS[mod]:
+            self._add(node, 'A003',
+                      'raw "%s.%s()" breaks netsim replay; use the '
+                      'utils clock/RNG seams (current_millis/'
+                      'wall_time/get_rng)' % (mod, f.attr))
+            return
+        if mod == 'random' and f.attr not in _RANDOM_EXEMPT:
+            self._add(node, 'A003',
+                      'raw "random.%s()" draws from the global '
+                      'stream; use utils.get_rng() so netsim seeds '
+                      'pin it' % f.attr)
+            return
+        if f.attr in _DATETIME_READS and \
+                self._is_datetime_value(f.value):
+            self._add(node, 'A003',
+                      'raw "datetime...%s()" reads the wall clock; '
+                      'derive from utils.wall_time() instead'
+                      % f.attr)
+
+    def _is_datetime_value(self, node) -> bool:
+        """Does `node` name the datetime module or its datetime/date
+        classes (``datetime.datetime``, ``from datetime import
+        datetime``)?"""
+        if isinstance(node, ast.Name):
+            if self.info.module_of(node.id) == 'datetime':
+                return True
+            imp = self.info.from_imports.get(node.id)
+            return imp is not None and imp[0] == 'datetime'
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name):
+            return self.info.module_of(node.value.id) == 'datetime' \
+                and node.attr in ('datetime', 'date')
+        return False
+
+    def _check_clock_name(self, node, f) -> None:
+        if self.info.rel == _SEAM_DEFINITION:
+            return
+        imp = self.info.from_imports.get(f.id)
+        if imp is None:
+            return
+        src, orig = imp
+        if src in _CLOCK_CALLS and orig in _CLOCK_CALLS[src]:
+            self._add(node, 'A003',
+                      'raw "%s()" (%s.%s) breaks netsim replay; use '
+                      'the utils clock/RNG seams' % (f.id, src, orig))
+        elif src == 'random' and orig not in _RANDOM_EXEMPT:
+            self._add(node, 'A003',
+                      'raw "%s()" (random.%s) draws from the global '
+                      'stream; use utils.get_rng()' % (f.id, orig))
+        elif src == 'datetime' and orig in ('datetime', 'date'):
+            pass     # handled as attribute reads on the class
+
+
+# ---------------------------------------------------------------------------
+# A005: phase-seam coverage (program-level)
+
+
+def _check_seams(program: Program, collect) -> None:
+    reg = program.seam_registry
+    reg_rel = program.seam_registry_rel
+    if reg is None:
+        return       # no profile registry in the scanned set
+    reg_info = program.files[reg_rel]
+    registered: set[str] = set()
+    for dotted, lineno in reg:
+        rel = _dotted_to_rel(dotted)
+        registered.add(rel)
+        info = program.files.get(rel) if rel else None
+        if info is None:
+            if not is_suppressed(reg_info.sup, lineno, 'A005'):
+                collect(Violation(
+                    reg_info.path, lineno, 'A005',
+                    'seam registry names "%s" but no such module is '
+                    'in the scanned set' % dotted))
+            continue
+        if info.prof_def_line is None:
+            if not is_suppressed(reg_info.sup, lineno, 'A005'):
+                collect(Violation(
+                    reg_info.path, lineno, 'A005',
+                    'registered seam module "%s" defines no '
+                    'module-level _prof' % dotted))
+        elif not info.prof_read:
+            if not is_suppressed(info.sup, info.prof_def_line,
+                                 'A005'):
+                collect(Violation(
+                    info.path, info.prof_def_line, 'A005',
+                    '_prof seam is defined but never read: phase '
+                    'timing is not routed through it'))
+    for rel, info in sorted(program.files.items()):
+        if info.prof_def_line is not None and rel != reg_rel and \
+                rel not in registered:
+            if not is_suppressed(info.sup, info.prof_def_line,
+                                 'A005'):
+                collect(Violation(
+                    info.path, info.prof_def_line, 'A005',
+                    'module defines a _prof seam but is missing from '
+                    'profile._SEAM_MODULES: the sampler never binds '
+                    'it and the ledger identity goes partial'))
+    for rel, info in sorted(program.files.items()):
+        _check_push_pop(info, collect)
+
+
+def _own_scope(func):
+    """Walk a function body WITHOUT descending into nested defs or
+    lambdas (cbfsm's _awaits_in_entry scoping): a nested callback's
+    pushes are its own responsibility."""
+    stack = list(func.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _check_push_pop(info: ModuleInfo, collect) -> None:
+    """Every function calling ``push_phase`` must call ``pop_phase``
+    from a ``finally`` block, or a raise mid-phase corrupts the
+    attribution for every later sample."""
+    for func in ast.walk(info.tree):
+        if not isinstance(func, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if func.name in ('push_phase', 'pop_phase'):
+            continue
+        pushes = []
+        pops_in_finally = 0
+        for node in _own_scope(func):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == 'push_phase':
+                pushes.append(node)
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) and \
+                                isinstance(sub.func,
+                                           ast.Attribute) and \
+                                sub.func.attr == 'pop_phase':
+                            pops_in_finally += 1
+        for push in pushes[pops_in_finally:]:
+            if not is_suppressed(info.sup, push.lineno, 'A005'):
+                collect(Violation(
+                    info.path, push.lineno, 'A005',
+                    'push_phase without a matching pop_phase in a '
+                    'finally block: a raise mid-phase corrupts '
+                    'sampler attribution'))
+
+
+# ---------------------------------------------------------------------------
+# Driving
+
+
+def iter_targets(args: list[str]):
+    for a in args:
+        p = Path(a)
+        if p.is_dir():
+            yield from sorted(p.rglob('*.py'))
+        else:
+            yield p
+
+
+def build_program(paths: list[str]) -> Program:
+    program = Program()
+    for t in iter_targets(paths):
+        rel = package_rel(str(t))
+        if rel is None:
+            continue
+        try:
+            text = t.read_text(encoding='utf-8')
+        except OSError:
+            continue
+        info = _index_module(str(t), rel, text)
+        if info is not None:
+            program.add(info)
+    program.finish()
+    return program
+
+
+def analyze_program(program: Program,
+                    raw: bool = False) -> list[Violation]:
+    """All A-rule violations. ``raw=True`` ignores suppressions (the
+    U001 audit's view of what would fire)."""
+    out: list[Violation] = []
+    if raw:
+        saved = [(info, info.sup) for info in program.files.values()]
+        for info, _ in saved:
+            info.sup = {}
+    try:
+        for rel in sorted(program.files):
+            info = program.files[rel]
+            _FlowVisitor(program, info, out.append).visit(info.tree)
+        _check_seams(program, out.append)
+    finally:
+        if raw:
+            for info, sup in saved:
+                info.sup = sup
+    return out
+
+
+def analyze_paths(paths: list[str], raw: bool = False):
+    """(program, violations) — import surface for the tests and the
+    static/dynamic conformance suite."""
+    program = build_program(paths)
+    return program, analyze_program(program, raw=raw)
+
+
+# ---------------------------------------------------------------------------
+# U001: unused-suppression audit across cbfsm / cblint / cbflow
+
+
+def _load_sibling(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, Path(__file__).resolve().parent / ('%s.py' % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _comment_suppressions(text: str, tool_re) -> list[tuple]:
+    """[(line, codes-or-None)] for REAL comment tokens matching a
+    tool's suppression pattern — suppression-shaped string literals
+    (fixture corpora in tests) don't count."""
+    out = []
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(text).readline)
+        for ttype, s, (srow, _), _e, _l in toks:
+            if ttype != tokenize.COMMENT:
+                continue
+            m = tool_re.search(s.rstrip())
+            if m is None:
+                continue
+            codes = m.group(1)
+            if codes is None:
+                out.append((srow, None))
+            else:
+                out.append((srow, sorted(
+                    c.strip() for c in codes.split(',')
+                    if c.strip())))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def audit_suppressions(paths: list[str]) -> list[Violation]:
+    """U001 over every target file: each cbfsm/cblint/cbflow
+    suppression comment must still shadow at least one live raw
+    violation of each code it names."""
+    cblint = _load_sibling('cblint')
+    cbfsm = _load_sibling('cbfsm')
+    targets = [t for t in iter_targets(paths)]
+    program = build_program([str(t) for t in targets])
+    flow_raw: dict[str, dict] = {}
+    for v in analyze_program(program, raw=True):
+        flow_raw.setdefault(v.path, {}).setdefault(
+            v.line, set()).add(v.code)
+    out: list[Violation] = []
+    for t in targets:
+        try:
+            text = t.read_text(encoding='utf-8', errors='replace')
+        except OSError:
+            continue
+        path = str(t)
+        per_tool = {
+            'cblint': (cblint._SUPPRESS_RE,
+                       lambda: cblint.check_style(path, text, {}) +
+                       cblint.check_correctness(path, text, {}) +
+                       cblint.check_layering(path, text, {})),
+            'cbfsm': (cbfsm._SUPPRESS_RE,
+                      lambda: cbfsm.analyze_file(Path(path),
+                                                 sup={})[1]),
+            'cbflow': (_SUPPRESS_RE, None),
+        }
+        for tool, (tool_re, raw_fn) in per_tool.items():
+            sups = _comment_suppressions(text, tool_re)
+            if not sups:
+                continue
+            if raw_fn is not None:
+                fired: dict[int, set] = {}
+                for v in raw_fn():
+                    fired.setdefault(v.line, set()).add(v.code)
+            else:
+                fired = flow_raw.get(path, {})
+            for line, codes in sups:
+                live = fired.get(line, set())
+                if codes is None:
+                    if not live:
+                        out.append(Violation(
+                            path, line, 'U001',
+                            '%s suppression never fires: no %s rule '
+                            'triggers on this line; delete it'
+                            % (tool, tool)))
+                    continue
+                for code in codes:
+                    if code not in live:
+                        out.append(Violation(
+                            path, line, 'U001',
+                            '%s suppression for %s never fires on '
+                            'this line; delete it' % (tool, code)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv: list[str]) -> int:
+    fmt = 'text'
+    audit = False
+    paths: list[str] = []
+    for a in argv:
+        if a == '--format=json':
+            fmt = 'json'
+        elif a == '--audit-suppressions':
+            audit = True
+        else:
+            paths.append(a)
+    if not paths:
+        print('cbflow: no targets', file=sys.stderr)
+        return 2
+
+    if audit:
+        violations = audit_suppressions(paths)
+        scanned = len(list(iter_targets(paths)))
+    else:
+        program, violations = analyze_paths(paths)
+        scanned = len(program.files)
+
+    if fmt == 'json':
+        for v in violations:
+            print(json.dumps(v.to_json(), sort_keys=True))
+        return 1 if violations else 0
+    for v in violations:
+        print(v)
+    if violations:
+        print('cbflow: %d violation(s) in %d file(s)' % (
+            len(violations), len({v.path for v in violations})))
+        return 1
+    if audit:
+        print('cbflow: suppression inventory clean across %d file(s)'
+              % scanned)
+    else:
+        print('cbflow: %d module(s) clean' % scanned)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1:]))

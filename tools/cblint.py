@@ -89,11 +89,15 @@ class Violation:
         }, sort_keys=True)
 
 
-def check_style(path: str, text: str) -> list[Violation]:
-    """The jsstyle half: mechanical per-line rules."""
+def check_style(path: str, text: str,
+                sup: dict | None = None) -> list[Violation]:
+    """The jsstyle half: mechanical per-line rules. Pass ``sup={}``
+    to see raw violations with suppressions disabled (the cbflow
+    U001 audit's view)."""
     out = []
     lines = text.split('\n')
-    sup = parse_suppressions(text)
+    if sup is None:
+        sup = parse_suppressions(text)
 
     def add(row, code, msg):
         if not is_suppressed(sup, row, code):
@@ -452,25 +456,31 @@ class _LayeringVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def check_layering(path: str, text: str) -> list[Violation]:
+def check_layering(path: str, text: str,
+                   sup: dict | None = None) -> list[Violation]:
     if not layering_applies(path):
         return []
     try:
         tree = ast.parse(text, filename=path)
     except SyntaxError:
         return []     # C100 reports the parse failure
-    v = _LayeringVisitor(path, parse_suppressions(text))
+    if sup is None:
+        sup = parse_suppressions(text)
+    v = _LayeringVisitor(path, sup)
     v.visit(tree)
     return v.out
 
 
-def check_correctness(path: str, text: str) -> list[Violation]:
+def check_correctness(path: str, text: str,
+                      sup: dict | None = None) -> list[Violation]:
     try:
         tree = ast.parse(text, filename=path)
     except SyntaxError as e:
         return [Violation(path, e.lineno or 0, 'C100',
                           'syntax error: %s' % e.msg)]
-    v = _CorrectnessVisitor(path, parse_suppressions(text))
+    if sup is None:
+        sup = parse_suppressions(text)
+    v = _CorrectnessVisitor(path, sup)
     v.visit(tree)
     v.finish(tree, text)
     return v.out
