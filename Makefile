@@ -5,9 +5,9 @@ LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
 .PHONY: test check lint bench bench-host bench-sharded bench-control \
-	bench-health bench-profile profile dryrun coverage native \
-	native-sanitize ci docs docs-check fsm-graph scenarios \
-	scenarios-fast
+	bench-health bench-profile bench-transport profile dryrun \
+	coverage native native-sanitize ci docs docs-check fsm-graph \
+	scenarios scenarios-fast
 
 native:
 	$(PYTHON) native/build.py
@@ -123,6 +123,14 @@ bench-health:
 # flamegraph identity receipt. One JSON line.
 bench-profile:
 	$(PYTHON) bench.py --profile-only
+
+# Transport wire-ledger stage alone (docs/transport.md §Wire ledger):
+# the wiretap-off/on claim-path A/B over the real asyncio transport
+# on loopback, with an untimed throwaway pool settled inside each
+# on-arm's enabled window as the ledger-fed anti-vacuity receipt.
+# One JSON line.
+bench-transport:
+	$(PYTHON) bench.py --transport-only
 
 # Attach the claim-path profiler to a RUNNING kang process:
 #   make profile PID=<pid> PORT=<kang port> [SECONDS=2]

@@ -34,6 +34,7 @@ from .errors import (
     ConnectionError,
     ConnectionTimeoutError,
     ConnectionClosedError,
+    TransportNotAvailableError,
 )
 from .events import EventEmitter
 from .fsm import FSM
@@ -113,4 +114,5 @@ __all__ = [
     'ClaimHandleMisusedError', 'ClaimTimeoutError', 'NoBackendsError',
     'PoolFailedError', 'PoolStoppingError', 'ConnectionError',
     'ConnectionTimeoutError', 'ConnectionClosedError',
+    'TransportNotAvailableError',
 ]

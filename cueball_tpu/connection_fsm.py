@@ -36,6 +36,7 @@ from . import errors as mod_errors
 from . import runq as mod_runq
 from . import trace as mod_trace
 from . import utils as mod_utils
+from . import wiretap as mod_wiretap
 from .events import _native
 from .fsm import FSM
 from .runq import defer
@@ -371,6 +372,16 @@ class SocketMgrFSM(FSM):
             if tracer is not None:
                 tracer.connect_done(self.sm_backend.get('key'),
                                     *self.sm_last_connect)
+            if mod_wiretap._LEDGER is not None:
+                # Key the wire breakdown by the exact floats the
+                # tracer just recorded as the connect span, so the
+                # phase ledger's socket_wait decomposition can find
+                # it again at replay time.
+                sock = self.sm_socket
+                mod_wiretap._LEDGER.record_connect(
+                    getattr(sock, 'wt_transport', 'unknown'),
+                    *self.sm_last_connect,
+                    getattr(sock, 'wt_marks', None))
         self.reset_backoff()
 
         @_internal

@@ -196,6 +196,13 @@ def dump_fsm_histories(stream=None) -> str:
     if prof:
         buf.write(prof)
 
+    # Transport wire ledger: per-seam counters, socket_wait wire
+    # totals and loop-lag stats. Same absent-but-well-formed contract.
+    from . import wiretap as mod_wiretap
+    wire = mod_wiretap.dump_wiretap()
+    if wire:
+        buf.write(wire)
+
     report = buf.getvalue()
     if stream is not None:
         stream.write(report)
@@ -231,10 +238,13 @@ def _on_debug_signal(signum, frame) -> None:
     # raises out of a signal handler.
     try:
         from . import profile as mod_profile
+        from . import wiretap as mod_wiretap
         if mod_utils.stack_traces_enabled():
             mod_profile.start_sampler()
+            mod_wiretap.start_loop_lag_sampler()
         else:
             mod_profile.stop_sampler()
+            mod_wiretap.stop_loop_lag_sampler()
     except Exception:
         pass
     import asyncio

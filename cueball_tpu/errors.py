@@ -132,6 +132,25 @@ class ConnectionClosedError(CueBallError):
                 backend.get('address'), backend.get('port')))
 
 
+class TransportNotAvailableError(CueBallError):
+    """A transport backend is registered but its data path is not
+    built in this process (the ``native`` stub until native/transport
+    lands). Carries the seam that was asked for — ``'resolve'`` when
+    ``get_transport`` refused the backend at resolution time, else one
+    of the five seam method names — so callers and logs can tell a
+    missing build from a miswired call site."""
+
+    def __init__(self, seam: str, transport: str = 'native',
+                 cause: 'BaseException | None' = None):
+        self.seam = seam
+        self.transport = transport
+        super().__init__(
+            "transport %r is not available (seam %r): the data path "
+            "is not built in this process; register a real factory "
+            "via register_transport(%r, ...)" % (transport, seam,
+                                                 transport), cause)
+
+
 class ShardDeadError(CueBallError):
     """A FleetRouter call was routed to a shard whose event loop is no
     longer running (loop stopped, thread exited, or child process

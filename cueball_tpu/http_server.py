@@ -26,6 +26,11 @@ from PoolMonitor.to_kang_options().
     GET /kang/profile           - claim-path profile as collapsed-stack
                                   flamegraph text (ledger phases +
                                   sampler hits; empty when idle)
+    GET /kang/transport         - transport wire ledger: per-seam
+                                  byte/syscall counters, socket_wait
+                                  wire totals and loop-lag stats;
+                                  ?transport=<name> / ?seam=<name>
+                                  narrow the counter table
     GET /metrics                - prometheus text metrics (collector)
 """
 
@@ -258,6 +263,50 @@ def _route(method: str, path: str, collector):
                 text = '\n'.join(kept) + '\n' if kept else ''
             body = text.encode()
             ctype = 'text/plain; charset=utf-8'
+        elif path == '/kang/transport':
+            # The wiretap ledger: per-(transport, seam) counters, the
+            # socket_wait wire totals and loop-lag sampler stats.
+            # ?transport=<name> / ?seam=<name> narrow the counter
+            # table; malformed params are 400 JSON, per the
+            # /kang/traces convention.
+            from . import wiretap as mod_wiretap
+            params = urllib.parse.parse_qs(query,
+                                           keep_blank_values=True)
+            unknown = sorted(set(params) - {'transport', 'seam'})
+            if unknown:
+                return (400, ctype, json.dumps(
+                    {'error': 'unknown parameter(s) %s; supported: '
+                              'transport, seam'
+                              % ', '.join(unknown)}).encode())
+            seam = None
+            if 'seam' in params:
+                seam = params['seam'][-1]
+                if seam not in mod_wiretap.SEAMS:
+                    return (400, ctype, json.dumps(
+                        {'error': 'unknown seam %r; one of %s' % (
+                            seam, ', '.join(mod_wiretap.SEAMS))}
+                    ).encode())
+            transports = mod_wiretap.snapshot()
+            if 'transport' in params:
+                tname = params['transport'][-1]
+                if tname not in transports:
+                    return (400, ctype, json.dumps(
+                        {'error': 'unknown transport %r; active: %s'
+                                  % (tname,
+                                     ', '.join(sorted(transports))
+                                     or '(none)')}).encode())
+                transports = {tname: transports[tname]}
+            if seam is not None:
+                transports = {
+                    t: {seam: seams[seam]}
+                    for t, seams in transports.items()
+                    if seam in seams}
+            body = json.dumps({
+                'enabled': mod_wiretap.wiretap_enabled(),
+                'transports': transports,
+                'wire_ms': mod_wiretap.wire_totals(),
+                'loop_lag': mod_wiretap.loop_lag_stats(),
+            }, default=_json_default).encode()
         elif path == '/metrics' and collector is not None:
             body = collector.collect().encode()
             ctype = 'text/plain; version=0.0.4'

@@ -28,6 +28,8 @@ defined by connection_fsm. See docs/netsim.md.
 
 from __future__ import annotations
 
+from .. import utils as mod_utils
+from .. import wiretap as mod_wiretap
 from ..events import EventEmitter
 from ..fsm import get_loop
 
@@ -136,10 +138,25 @@ class SimConnection(EventEmitter):
             return
         self._timer = get_loop().call_later(delay, self._complete)
 
+    # Ledger label connection_fsm stamps wire records with
+    # (TcpStreamConnection carries its transport's name the same way).
+    wt_transport = 'fabric'
+
+    # Wire marks for the wiretap socket_wait decomposition: class
+    # default None (no handshake completed); _complete stamps
+    # (ready, dispatched) with ready == dispatched — a virtual link
+    # has no loop-dispatch gap, the whole connect latency is
+    # kernel_wait, which is what keeps the asyncio/fabric ledgers
+    # comparable.
+    wt_marks = None
+
     def _complete(self) -> None:
         if self.dead:
             return
         self.connected = True
+        if mod_wiretap.wiretap_enabled():
+            now = mod_utils.current_millis()
+            self.wt_marks = (now, now)
         self.emit('connect')
 
     def _fail(self, err) -> None:
@@ -185,6 +202,20 @@ class SimConnection(EventEmitter):
         if segments <= 0:
             done(True)
             return
+
+        # Wire accounting: the dribbled handshake is time spent
+        # waiting on the (virtual) kernel, not parsing — when wiretap
+        # is on, the elapsed probe time lands in the fabric
+        # transport's kernel_wait total. The claim-ledger PHASES view
+        # is unchanged (the probe runs inside the handshake phase).
+        if mod_wiretap.wiretap_enabled():
+            probe_start = mod_utils.current_millis()
+            inner_done = done
+
+            def done(ok, _inner=inner_done, _t0=probe_start):
+                mod_wiretap.wire_wait(
+                    'fabric', mod_utils.current_millis() - _t0)
+                _inner(ok)
 
         def step(k):
             if self.dead or not self.connected:

@@ -141,6 +141,20 @@ class Scenario:
                     }
             except Exception:
                 pass  # the dump must never mask the original error
+        wiretap = sys.modules.get('cueball_tpu.wiretap')
+        if wiretap is not None and wiretap.wiretap_enabled():
+            # The wire ledger was live during this scenario: embed the
+            # per-seam counters and socket_wait wire totals so the
+            # dump answers "did the bytes move, and where did the
+            # connect time go" next to the slow traces.
+            try:
+                record['wiretap'] = {
+                    'transports': wiretap.snapshot(),
+                    'wire_ms': wiretap.wire_totals(),
+                    'loop_lag': wiretap.loop_lag_stats(),
+                }
+            except Exception:
+                pass  # same rule: never mask the original error
         health = sys.modules.get('cueball_tpu.parallel.health')
         if health is not None:
             # The health engine ran during this scenario: embed every

@@ -84,6 +84,8 @@ _FLEET_GAUGES = {
     'max_sojourn': 'worst head-of-queue claim sojourn (ms)',
     'retry_frac': 'fraction of pools with slots in retry backoff',
     'mean_retry_backoff': 'mean reproduced backoff delay (ms)',
+    'loop_lag_p99_us': 'worst observed event-loop callback lag p99 '
+                       '(us, wiretap loop-lag sampler; 0 when unarmed)',
 }
 
 # Per-row defaults for the event-maintained signal columns: the values
@@ -318,6 +320,14 @@ class FleetSampler:
         # float64: absolute wall-clock ms do not fit f32; the sojourn
         # subtraction happens in f64 and only the result narrows.
         self.fs_head_ts = np.zeros((cap,), np.float64)
+        # Loop-lag p99 (us) of the loop serving each row's pool, read
+        # from the wiretap sampler during the O(dirty) patch pass. A
+        # side array like fs_head_ts, NOT a _COL_DEFAULTS column: the
+        # signal columns feed FleetInputs(**placed) on device and the
+        # lag never participates in the batched law — it rides the
+        # host-side fleet row so the control step and health detector
+        # can condition on loop saturation.
+        self.fs_loop_lag = np.zeros((cap,), np.float64)
         self.fs_active = np.zeros((cap,), bool)
 
     def _ensure_state(self):
@@ -376,6 +386,9 @@ class FleetSampler:
         head = np.zeros((cap,), np.float64)
         head[:old] = self.fs_head_ts
         self.fs_head_ts = head
+        lag = np.zeros((cap,), np.float64)
+        lag[:old] = self.fs_loop_lag
+        self.fs_loop_lag = lag
         active = np.zeros((cap,), bool)
         active[:old] = self.fs_active
         self.fs_active = active
@@ -410,6 +423,7 @@ class FleetSampler:
         for name, arr in self.fs_cols.items():
             arr[row] = _COL_DEFAULTS[name]
         self.fs_head_ts[row] = 0.0
+        self.fs_loop_lag[row] = 0.0
         self.fs_active[row] = False
 
     def _assign_rows(self, pools: Mapping) -> None:
@@ -508,13 +522,19 @@ class FleetSampler:
             return
         cols = self.fs_cols
         head = self.fs_head_ts
+        lag_col = self.fs_loop_lag
         row_pool = self.fs_row_pool
+        # One sampler read per patch pass, not per row: every row this
+        # sampler touches lives on the loop this pass runs on.
+        from .. import wiretap as mod_wiretap
+        loop_lag = mod_wiretap.loop_lag_p99_us()
         for row in patch:
             pool = row_pool.get(row)
             if pool is None:
                 continue   # freed after the mark; row already reset
             g = self.gather_pool_signals(pool)
             head[row] = g['head_ts']
+            lag_col[row] = loop_lag
             cols['samples'][row] = g['sample']
             cols['target_delay'][row] = g['target_delay']
             cols['spares'][row] = g['spares']
@@ -642,6 +662,13 @@ class FleetSampler:
         self.fs_ticks += 1
 
         fleet_np = {k: float(v) for k, v in fleet.items()}
+        # Host-side column: worst loop-lag p99 across occupied rows
+        # (0.0 while the wiretap sampler is unarmed). Injected after
+        # the device step — the batched law never sees it — so it
+        # publishes and reduces like any other _FLEET_GAUGES key.
+        fleet_np['loop_lag_p99_us'] = (
+            float(self.fs_loop_lag[self.fs_active].max())
+            if bool(self.fs_active.any()) else 0.0)
         out_np = {k: np.asarray(v) for k, v in out.items()}
         per_pool = _TickPools(dict(self.fs_rows), arrays, out_np)
         # Per-row tick counters drive the actuation warm-up gates (both
@@ -867,8 +894,10 @@ def reduce_fleet(records, mesh=None, mesh_axes=('host', 'chip')):
     ``records`` is a list of shard samplers' ``record['fleet']`` dicts
     (the :data:`_FLEET_GAUGES` keys). ``n_pools`` sums; the mean and
     fraction fields combine weighted by each shard's pool count;
-    ``max_sojourn`` takes the worst shard. Shards with zero pools
-    contribute nothing to the weighted fields.
+    ``max_sojourn`` and ``loop_lag_p99_us`` take the worst shard (one
+    saturated loop is the signal, a fleet-weighted mean would bury
+    it). Shards with zero pools contribute nothing to the weighted
+    fields.
 
     With a ``mesh``, the per-shard columns are placed sharded over the
     flattened ``mesh_axes`` (the same 2-D ('host', 'chip') layout the
@@ -893,7 +922,7 @@ def reduce_fleet(records, mesh=None, mesh_axes=('host', 'chip')):
         for name in names:
             if name == 'n_pools':
                 out[name] = tot
-            elif name == 'max_sojourn':
+            elif name in ('max_sojourn', 'loop_lag_p99_us'):
                 out[name] = float(cols[name].max())
             else:
                 out[name] = float((cols[name] * w).sum() / safe)
@@ -916,7 +945,7 @@ def reduce_fleet(records, mesh=None, mesh_axes=('host', 'chip')):
     for name in names:
         if name == 'n_pools':
             out[name] = float(tot)
-        elif name == 'max_sojourn':
+        elif name in ('max_sojourn', 'loop_lag_p99_us'):
             out[name] = float(jnp.max(dev[name]))
         else:
             out[name] = float(jnp.sum(dev[name] * w) / safe)

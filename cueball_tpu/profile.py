@@ -36,10 +36,12 @@ import sys
 
 from . import trace as mod_trace
 from . import utils as mod_utils
+from . import wiretap as mod_wiretap
 from .events import _native
 
 __all__ = [
     'PHASES',
+    'SUB_PHASES',
     'claim_ledger',
     'phase_ledger',
     'ledger_summary',
@@ -60,6 +62,16 @@ __all__ = [
 #: cueball_claim_phase_ms histogram label values are drawn from it.
 PHASES = ('queue_wait', 'codel', 'runq_pump', 'fsm',
           'socket_wait', 'handshake', 'lease', 'other')
+
+#: socket_wait sub-phases (re-exported from wiretap, the module that
+#: defines them): where the opaque socket_wait phase actually went —
+#: in-kernel readiness wait, event-loop dispatch lag, or Python
+#: protocol/constructor work. They live in ``led['wire']``, NOT in
+#: ``led['phases']``: PHASES membership is the C sampler / histogram
+#: label contract and the ``sum(phases) == wall`` identity stays on
+#: the eight named phases, while ``sum(led['wire'].values()) ==
+#: phases['socket_wait']`` holds exactly per claim.
+SUB_PHASES = mod_wiretap.SUB_PHASES
 
 # C PROF_PHASE_* numbering -> phase name (index = C constant).
 _PHASE_BY_ID = ('other', 'queue_wait', 'codel', 'runq_pump', 'fsm',
@@ -110,6 +122,7 @@ def claim_ledger(trace) -> dict | None:
         return None
     wall = root.end - root.start
     queue_wait = handshake = lease = socket_wait = 0.0
+    connect_parts = []
     for span in trace.spans[1:]:
         d = span.duration()
         if d is None:
@@ -122,9 +135,12 @@ def claim_ledger(trace) -> dict | None:
             lease += d
         elif span.name == 'connect' and span.attrs.get('during_claim'):
             # Only the part inside the claim window counts against it.
-            socket_wait += max(
+            part = max(
                 0.0, min(span.end, root.end) - max(span.start,
                                                    root.start))
+            socket_wait += part
+            if part > 0.0:
+                connect_parts.append((span.start, span.end, part))
     socket_wait = min(socket_wait, queue_wait)
     queue_wait -= socket_wait
     phases = {
@@ -138,8 +154,12 @@ def claim_ledger(trace) -> dict | None:
     }
     named = sum(phases.values())
     phases['other'] = max(wall - named, 0.0)
+    wire, decomposed = _decompose_socket_wait(socket_wait,
+                                              connect_parts)
     return {
         'trace_id': trace.trace_id,
+        'wire': wire,
+        'wire_decomposed': decomposed,
         'pool': root.attrs.get('pool', ''),
         'domain': root.attrs.get('domain', ''),
         'shard': root.attrs.get('shard'),
@@ -149,6 +169,50 @@ def claim_ledger(trace) -> dict | None:
         'phases': phases,
         'coverage': (named / wall) if wall > 0.0 else 1.0,
     }
+
+
+def _decompose_socket_wait(socket_wait: float, connect_parts) -> tuple:
+    """Split one claim's socket_wait across :data:`SUB_PHASES` using
+    the wiretap ledger's per-connect breakdowns (keyed by the exact
+    connect-span floats). Returns ``(wire_dict, decomposed)``;
+    without wiretap data the whole phase is attributed to kernel_wait
+    (``decomposed`` False). The returned values are nudged so
+    ``kernel_wait + loop_dispatch + proto_parse == socket_wait`` holds
+    under plain float addition — the per-claim identity the parity
+    and scenario gates assert with ``==``."""
+    if socket_wait > 0.0 and connect_parts and \
+            mod_wiretap._LEDGER is not None:
+        kernel = dispatch = parse = 0.0
+        found = False
+        for start, end, part in connect_parts:
+            bk = mod_wiretap._LEDGER.connect_breakdown(start, end)
+            if bk is None:
+                continue
+            span_len = end - start
+            f = (part / span_len) if span_len > 0.0 else 0.0
+            kernel += bk[0] * f
+            dispatch += bk[1] * f
+            parse += bk[2] * f
+            found = True
+        total = kernel + dispatch + parse
+        if found and total > 0.0:
+            scale = socket_wait / total
+            kernel *= scale
+            dispatch *= scale
+            parse = socket_wait - kernel - dispatch
+            if parse < 0.0:
+                kernel += parse
+                parse = 0.0
+            if kernel + dispatch + parse != socket_wait:
+                kernel = socket_wait - dispatch - parse
+            if kernel < 0.0 or \
+                    kernel + dispatch + parse != socket_wait:
+                kernel, dispatch, parse = socket_wait, 0.0, 0.0
+            return ({'kernel_wait': kernel,
+                     'loop_dispatch': dispatch,
+                     'proto_parse': parse}, True)
+    return ({'kernel_wait': socket_wait, 'loop_dispatch': 0.0,
+             'proto_parse': 0.0}, False)
 
 
 def phase_ledger(traces=None) -> list:
@@ -166,8 +230,14 @@ def phase_ledger(traces=None) -> list:
 
 def ledger_summary(ledgers) -> dict:
     """Fold per-claim ledgers into one cost-attribution record:
-    total wall, per-phase totals, and the wall-weighted coverage."""
+    total wall, per-phase totals, the wall-weighted coverage, and the
+    socket_wait wire sub-phase totals (``wire_ms``/``wire_claims``
+    fold only claims the wiretap ledger actually decomposed, so the
+    undecomposed remainder stays visibly in the opaque parent
+    phase)."""
     phase_ms = {p: 0.0 for p in PHASES}
+    wire_ms = {p: 0.0 for p in SUB_PHASES}
+    wire_claims = 0
     wall = 0.0
     named = 0.0
     n = 0
@@ -176,11 +246,17 @@ def ledger_summary(ledgers) -> dict:
         wall += led['wall_ms']
         for p, ms in led['phases'].items():
             phase_ms[p] = phase_ms.get(p, 0.0) + ms
+        if led.get('wire_decomposed'):
+            wire_claims += 1
+            for p, ms in led['wire'].items():
+                wire_ms[p] = wire_ms.get(p, 0.0) + ms
         named += led['wall_ms'] * led['coverage']
     return {
         'claims': n,
         'wall_ms': wall,
         'phase_ms': phase_ms,
+        'wire_ms': wire_ms,
+        'wire_claims': wire_claims,
         'coverage': (named / wall) if wall > 0.0 else 1.0,
     }
 
@@ -211,6 +287,8 @@ def reduce_profile(records) -> dict:
     re-derived wall-weighted, and the per-shard records ride along."""
     records = [r for r in records if r]
     phase_ms = {p: 0.0 for p in PHASES}
+    wire_ms = {p: 0.0 for p in SUB_PHASES}
+    wire_claims = 0
     wall = 0.0
     named = 0.0
     claims = 0
@@ -219,12 +297,17 @@ def reduce_profile(records) -> dict:
         wall += rec.get('wall_ms', 0.0)
         for p, ms in (rec.get('phase_ms') or {}).items():
             phase_ms[p] = phase_ms.get(p, 0.0) + ms
+        for p, ms in (rec.get('wire_ms') or {}).items():
+            wire_ms[p] = wire_ms.get(p, 0.0) + ms
+        wire_claims += rec.get('wire_claims', 0)
         named += rec.get('wall_ms', 0.0) * rec.get('coverage', 0.0)
     return {
         'n_shards': len(records),
         'claims': claims,
         'wall_ms': wall,
         'phase_ms': phase_ms,
+        'wire_ms': wire_ms,
+        'wire_claims': wire_claims,
         'coverage': (named / wall) if wall > 0.0 else 1.0,
         'shards': records,
     }
@@ -436,7 +519,24 @@ def flamegraph(traces=None) -> str:
     total = ledger_summary(phase_ledger(traces))
     out = []
     for phase in PHASES:
-        us = int(round(total['phase_ms'].get(phase, 0.0) * _US_PER_MS))
+        ms = total['phase_ms'].get(phase, 0.0)
+        if phase == 'socket_wait' and total.get('wire_claims', 0) > 0:
+            # Wiretap decomposed at least one claim: nest the wire
+            # sub-phases under the parent frame, keeping only the
+            # undecomposed remainder on the parent line. With wiretap
+            # off this branch never runs and the output stays
+            # byte-identical to the un-decomposed format.
+            wire = total['wire_ms']
+            residual = ms - sum(wire.values())
+            us = int(round(max(residual, 0.0) * _US_PER_MS))
+            if us > 0:
+                out.append('claim;%s %d' % (phase, us))
+            for sub in SUB_PHASES:
+                sub_us = int(round(wire.get(sub, 0.0) * _US_PER_MS))
+                if sub_us > 0:
+                    out.append('claim;%s;%s %d' % (phase, sub, sub_us))
+            continue
+        us = int(round(ms * _US_PER_MS))
         if us > 0:
             out.append('claim;%s %d' % (phase, us))
     _collect_samples()
@@ -479,6 +579,11 @@ def dump_profile(limit: int = 5) -> str:
         out.append('  ledger: %d claims wall=%.1fms coverage=%.3f %s'
                    % (total['claims'], total['wall_ms'],
                       total['coverage'], ' '.join(parts)))
+        if total.get('wire_claims', 0) > 0:
+            out.append('  socket_wait wire: %s (%d claims decomposed)'
+                       % (' '.join('%s=%.1f' % (p, total['wire_ms'][p])
+                                   for p in SUB_PHASES),
+                          total['wire_claims']))
         slow = sorted(ledgers, key=lambda led: led['wall_ms'],
                       reverse=True)[:limit]
         for led in slow:

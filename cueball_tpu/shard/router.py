@@ -588,6 +588,37 @@ class FleetRouter:
         from .. import profile as mod_profile
         return mod_profile.reduce_profile(records)
 
+    def _wiretap_shard(self, shard_id: int):
+        # Runs inside the shard loop: the loop-lag stats are loop-local
+        # (the whole point of the column), so they must be read from
+        # the shard's own loop; the transport ledger itself is
+        # process-global and rides along once in the reduction.
+        from .. import wiretap as mod_wiretap
+        return mod_wiretap.wiretap_record(shard=shard_id)
+
+    async def wiretap_fleet(self):
+        """One wiretap pass: each running shard reports its loop-lag
+        stats from its own loop, then the records merge shard->host
+        with :func:`wiretap.reduce_wiretap` (lag folds worst-case —
+        one saturated loop is the signal — and the process-global
+        transport ledger rides along once). Mirrors
+        :meth:`profile_fleet`; not offered for the spawn backend
+        (children expose /kang/transport and /metrics; merge their
+        scrapes with metrics.merge_expositions)."""
+        if self.fr_backend == 'spawn':
+            raise CueBallError(
+                'wiretap_fleet is not available on the spawn backend; '
+                'scrape the children and merge with merge_expositions')
+        records = []
+        for sid, fsm in sorted(self.fr_fsms.items()):
+            if not fsm.is_in_state('running'):
+                continue
+            rec = await self.run_on(sid, self._wiretap_shard, sid)
+            if rec:
+                records.append(rec)
+        from .. import wiretap as mod_wiretap
+        return mod_wiretap.reduce_wiretap(records)
+
     async def sample_fleet(self, mesh=None, mesh_axes=('host', 'chip')):
         """One per-shard FleetSampler pass each on its own loop, then
         the shard->host reduction (and host->mesh when ``mesh`` is

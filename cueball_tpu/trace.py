@@ -70,6 +70,22 @@ _EV_DNS_QBEGIN = 11
 _EV_DNS_QEND = 12
 _EV_DNS_DONE = 13
 
+# Reserved wire-event codes (WEV_* in the future native transport):
+# the fixed slots a native data path appends per Transport seam for
+# the wiretap ledger. They share the event ring with the TREV_* codes
+# above but are NOT trace events — _drain_native skips them without
+# touching the pending map or the truncation counter. The mapping is
+# part of the NativeTransport conformance contract and follows
+# transport.SEAM_METHODS / wiretap.SEAMS order.
+_EV_WIRE_FIRST = 14
+WIRE_EVENT_CODES = {
+    'connector': 14,
+    'create_stream': 15,
+    'serve': 16,
+    'dns_udp': 17,
+    'dns_tcp': 18,
+}
+
 # Cap on traces whose begin event has drained but whose terminal event
 # hasn't: protects the assembler against claims that never finish.
 _PENDING_MAX = 4096
@@ -794,6 +810,10 @@ class _TraceRuntime:
             return
         pending = self.tr_pending
         for code, serial, t, a, b, obj, flags in events:
+            if code >= _EV_WIRE_FIRST:
+                # Reserved wire-event slot (native transport wiretap
+                # counters): not a trace event, never truncation.
+                continue
             if code == _EV_CLAIM_BEGIN:
                 tid, ident = obj
                 pending[serial] = [

@@ -37,7 +37,17 @@ import asyncio
 import socket as mod_socket
 import struct
 
+from . import utils as mod_utils
+from . import wiretap as mod_wiretap
+from .errors import TransportNotAvailableError
 from .events import EventEmitter
+
+#: The five seam method names, in wiretap display order. This tuple
+#: and wiretap.SEAMS are the same contract stated twice — cbflow rule
+#: A006 (make check) fails if they drift from each other or from the
+#: Transport class's actual method set.
+SEAM_METHODS = ('connector', 'create_stream', 'serve', 'dns_udp',
+                'dns_tcp')
 
 
 class Transport:
@@ -56,6 +66,11 @@ class Transport:
     """
 
     name = 'abstract'
+    #: False on registered-but-stubbed backends (the native stub):
+    #: get_transport refuses them at resolution time with
+    #: TransportNotAvailableError instead of letting the first I/O
+    #: blow up deep inside a pool.
+    available = True
 
     # -- pool constructor seam -------------------------------------------
 
@@ -111,6 +126,24 @@ class WatchedStreamProtocol(asyncio.StreamReaderProtocol):
     def __init__(self, reader, owner, loop):
         super().__init__(reader, loop=loop)
         self._owner = owner
+        # Wire-ledger hooks: connection_made stamps the kernel
+        # readiness time (the wiretap socket_wait decomposition reads
+        # it as the kernel_wait/loop_dispatch boundary); _wt_stats is
+        # a SeamStats fed per data_received, or None when wiretap is
+        # off (one attribute load + None check per read).
+        self._wt_stats = None
+        self._wt_ready = None
+
+    def connection_made(self, transport):
+        self._wt_ready = mod_utils.current_millis()
+        super().connection_made(transport)
+
+    def data_received(self, data):
+        st = self._wt_stats
+        if st is not None:
+            st.reads += 1
+            st.bytes_in += len(data)
+        super().data_received(data)
 
     def eof_received(self):
         super().eof_received()
@@ -138,6 +171,11 @@ class TcpStreamConnection(EventEmitter):
         self.reader: asyncio.StreamReader | None = None
         self.writer: asyncio.StreamWriter | None = None
         self.destroyed = False
+        # (kernel-ready, dispatched) wire marks for the wiretap
+        # socket_wait decomposition; stamped by _connect. wt_transport
+        # is the ledger label connection_fsm uses for wire records.
+        self.wt_marks = None
+        self.wt_transport = transport.name
         self._task = asyncio.ensure_future(self._connect())
 
     def _on_connection_lost(self, exc):
@@ -152,14 +190,37 @@ class TcpStreamConnection(EventEmitter):
         try:
             loop = asyncio.get_running_loop()
             reader = asyncio.StreamReader(loop=loop)
-            stream, protocol = await self.transport.create_stream(
-                lambda: WatchedStreamProtocol(reader, self, loop),
-                self.backend['address'], self.backend['port'])
+            # Pool connects account to the 'connector' seam, so route
+            # around the instrumented create_stream wrapper when the
+            # transport has the raw opener (otherwise every pool
+            # connect would double-count as a create_stream event).
+            st = mod_wiretap.seam_stats(self.transport.name,
+                                        'connector')
+            opener = getattr(self.transport, '_open_stream', None)
+            if opener is None:
+                opener = self.transport.create_stream
+
+            def factory():
+                proto = WatchedStreamProtocol(reader, self, loop)
+                proto._wt_stats = st
+                return proto
+
+            stream, protocol = await opener(
+                factory, self.backend['address'], self.backend['port'])
+            ready = getattr(protocol, '_wt_ready', None)
+            if ready is not None:
+                self.wt_marks = (ready, mod_utils.current_millis())
             self.reader = reader
             self.writer = asyncio.StreamWriter(
                 stream, protocol, reader, loop)
+            if st is not None:
+                mod_wiretap.instrument_writer(st, self.writer)
             self.emit('connect')
         except OSError as e:
+            # No direct error count here: the connector seam's watch()
+            # listeners count the 'error' emit (same path netsim's
+            # SimConnection takes), keeping the two backends' ledgers
+            # comparable.
             self.emit('error', e)
         except asyncio.CancelledError:
             pass
@@ -208,10 +269,35 @@ class AsyncioTransport(Transport):
     name = 'asyncio'
 
     def connector(self, backend: dict) -> TcpStreamConnection:
-        return TcpStreamConnection(self, backend)
+        conn = TcpStreamConnection(self, backend)
+        st = mod_wiretap.seam_stats(self.name, 'connector')
+        if st is not None:
+            st.events += 1
+            mod_wiretap.watch(st, conn)
+        return conn
 
     async def create_stream(self, protocol_factory, host, port,
                             ssl=None, server_hostname=None):
+        st = mod_wiretap.seam_stats(self.name, 'create_stream')
+        if st is not None:
+            st.events += 1
+        try:
+            result = await self._open_stream(
+                protocol_factory, host, port, ssl=ssl,
+                server_hostname=server_hostname)
+        except OSError:
+            if st is not None:
+                st.errors += 1
+            raise
+        if st is not None:
+            st.connects += 1
+        return result
+
+    async def _open_stream(self, protocol_factory, host, port,
+                           ssl=None, server_hostname=None):
+        """The raw opener behind create_stream: same signature, no
+        wiretap accounting (the connector seam uses it so pool
+        connects land in their own ledger row)."""
         loop = asyncio.get_running_loop()
         kwargs = {}
         if ssl is not None:
@@ -236,33 +322,76 @@ class AsyncioTransport(Transport):
         return sock.getsockname()[1]
 
     async def serve(self, client_connected_cb, host, port):
+        st = mod_wiretap.seam_stats(self.name, 'serve')
+        if st is not None:
+            st.events += 1
+            inner_cb = client_connected_cb
+
+            def client_connected_cb(reader, writer):
+                st.connects += 1
+                return inner_cb(reader, writer)
+
         return await asyncio.start_server(
             client_connected_cb, host, port)
 
     async def dns_udp(self, resolver: str, port: int, payload: bytes,
                       timeout_s: float) -> bytes:
+        st = mod_wiretap.seam_stats(self.name, 'dns_udp')
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         qid = struct.unpack('>H', payload[:2])[0]
         stream, _ = await loop.create_datagram_endpoint(
             lambda: _UdpQuery(fut, qid), remote_addr=(resolver, port))
+        if st is not None:
+            st.events += 1
+            st.writes += 1
+            st.bytes_out += len(payload)
         try:
             stream.sendto(payload)
-            return await asyncio.wait_for(fut, timeout_s)
+            data = await asyncio.wait_for(fut, timeout_s)
+        except Exception:
+            if st is not None:
+                st.errors += 1
+            raise
         finally:
             stream.close()
+        if st is not None:
+            st.reads += 1
+            st.bytes_in += len(data)
+        return data
 
     async def dns_tcp(self, resolver: str, port: int, payload: bytes,
                       timeout_s: float) -> bytes:
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(resolver, port), timeout_s)
+        st = mod_wiretap.seam_stats(self.name, 'dns_tcp')
+        if st is not None:
+            st.events += 1
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(resolver, port), timeout_s)
+        except Exception:
+            if st is not None:
+                st.errors += 1
+            raise
+        if st is not None:
+            st.connects += 1
         try:
             writer.write(struct.pack('>H', len(payload)) + payload)
             await writer.drain()
+            if st is not None:
+                st.writes += 1
+                st.bytes_out += len(payload) + 2
             ln = struct.unpack('>H', await asyncio.wait_for(
                 reader.readexactly(2), timeout_s))[0]
-            return await asyncio.wait_for(
+            body = await asyncio.wait_for(
                 reader.readexactly(ln), timeout_s)
+            if st is not None:
+                st.reads += 2
+                st.bytes_in += ln + 2
+            return body
+        except Exception:
+            if st is not None:
+                st.errors += 1
+            raise
         finally:
             writer.close()
 
@@ -283,21 +412,59 @@ class FabricTransport(Transport):
         self._ident = ident
 
     def connector(self, backend: dict):
-        return self.fabric.constructor(backend)
+        conn = self.fabric.constructor(backend)
+        st = mod_wiretap.seam_stats(self.name, 'connector')
+        if st is not None:
+            st.events += 1
+            mod_wiretap.watch(st, conn)
+        return conn
 
     async def dns_udp(self, resolver: str, port: int, payload: bytes,
                       timeout_s: float) -> bytes:
         if self.wire is None:
             raise NotImplementedError(
                 'FabricTransport has no SimWire attached')
-        return await self.wire.udp(resolver, port, payload, timeout_s)
+        st = mod_wiretap.seam_stats(self.name, 'dns_udp')
+        if st is not None:
+            st.events += 1
+            st.writes += 1
+            st.bytes_out += len(payload)
+        try:
+            data = await self.wire.udp(resolver, port, payload,
+                                       timeout_s)
+        except Exception:
+            if st is not None:
+                st.errors += 1
+            raise
+        if st is not None:
+            st.reads += 1
+            st.bytes_in += len(data)
+        return data
 
     async def dns_tcp(self, resolver: str, port: int, payload: bytes,
                       timeout_s: float) -> bytes:
         if self.wire is None:
             raise NotImplementedError(
                 'FabricTransport has no SimWire attached')
-        return await self.wire.tcp(resolver, port, payload, timeout_s)
+        st = mod_wiretap.seam_stats(self.name, 'dns_tcp')
+        if st is not None:
+            st.events += 1
+        try:
+            data = await self.wire.tcp(resolver, port, payload,
+                                       timeout_s)
+        except Exception:
+            if st is not None:
+                st.errors += 1
+            raise
+        if st is not None:
+            # Mirror the asyncio seam's syscall-equivalent shape: one
+            # framed write out, length-prefix + body reads back.
+            st.connects += 1
+            st.writes += 1
+            st.bytes_out += len(payload) + 2
+            st.reads += 2
+            st.bytes_in += len(data) + 2
+        return data
 
     def host_ident(self) -> str:
         return self._ident
@@ -306,11 +473,37 @@ class FabricTransport(Transport):
 class NativeTransport(Transport):
     """The plug-in surface for the C data path (native/transport, next
     PR): a registered-but-stubbed backend so the dispatch plumbing,
-    the registry name and the docs contract all exist before the
-    first native byte moves. Every seam raises until the native module
-    fills it in via :func:`register_transport`."""
+    the registry name, the docs contract and the wiretap conformance
+    counters (trace.WIRE_EVENT_CODES) all exist before the first
+    native byte moves. Every seam raises a typed
+    :class:`TransportNotAvailableError` carrying the seam name, and
+    ``available = False`` makes ``get_transport('native')`` refuse at
+    resolution time rather than at first I/O; a real native module
+    replaces this via :func:`register_transport`."""
 
     name = 'native'
+    available = False
+
+    def _unavailable(self, seam: str):
+        raise TransportNotAvailableError(seam, transport=self.name)
+
+    def connector(self, backend: dict):
+        self._unavailable('connector')
+
+    async def create_stream(self, protocol_factory, host, port,
+                            ssl=None, server_hostname=None):
+        self._unavailable('create_stream')
+
+    async def serve(self, client_connected_cb, host, port):
+        self._unavailable('serve')
+
+    async def dns_udp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        self._unavailable('dns_udp')
+
+    async def dns_tcp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        self._unavailable('dns_tcp')
 
 
 # -- registry ---------------------------------------------------------------
@@ -340,7 +533,14 @@ def get_transport(spec=None) -> Transport:
         if factory is None:
             raise ValueError('unknown transport %r (registered: %s)' % (
                 spec, ', '.join(sorted(_REGISTRY))))
-        return factory()
+        t = factory()
+        if not getattr(t, 'available', True):
+            # Fail at resolution time, not first I/O: a pool handed a
+            # stub transport would otherwise come up healthy and die
+            # on its first connect.
+            raise TransportNotAvailableError('resolve',
+                                             transport=t.name)
+        return t
     if isinstance(spec, Transport):
         return spec
     raise TypeError('transport must be None, a name or a Transport, '
@@ -355,5 +555,6 @@ def host_ident() -> str:
 
 __all__ = ['Transport', 'AsyncioTransport', 'FabricTransport',
            'NativeTransport', 'TcpStreamConnection',
-           'WatchedStreamProtocol', 'register_transport',
+           'WatchedStreamProtocol', 'TransportNotAvailableError',
+           'SEAM_METHODS', 'register_transport',
            'get_transport', 'host_ident']

@@ -21,6 +21,7 @@ import asyncio
 from cueball_tpu import netsim
 from cueball_tpu import profile as mod_profile
 from cueball_tpu import trace as mod_trace
+from cueball_tpu import wiretap as mod_wiretap
 from cueball_tpu.transport import FabricTransport
 
 import pytest
@@ -94,3 +95,24 @@ def test_trickle_run_is_deterministic(seed):
     strip_b = [{k: v for k, v in led.items() if k != 'trace_id'}
                for led in ledgers_b]
     assert strip == strip_b
+
+
+def test_trickle_delay_lands_in_wire_kernel_wait():
+    """The wire-ledger view of the same fault: the dribble is time
+    spent waiting on segments the peer hasn't sent — in-kernel wait,
+    NOT protocol parsing. SimConnection's claim-readiness probe
+    attributes it via wiretap.wire_wait, so the fabric's kernel_wait
+    total absorbs ~STALL_MS per claim while proto_parse stays flat."""
+    mod_wiretap.enable_wiretap()
+    try:
+        _trace, ledgers = _run(11, SEGMENTS)
+        totals = mod_wiretap.wire_totals()
+    finally:
+        mod_wiretap.disable_wiretap()
+    assert len(ledgers) == 10
+    fabric_ms = totals.get('fabric')
+    assert fabric_ms is not None, totals
+    # 10 claims, each dribbled for STALL_MS of virtual time (exact on
+    # the virtual clock, up to float addition across timer hops).
+    assert fabric_ms['kernel_wait'] >= 10 * STALL_MS - 0.01
+    assert fabric_ms['proto_parse'] <= 1.0

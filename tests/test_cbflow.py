@@ -322,6 +322,92 @@ def test_a005_real_package_registry_is_total():
 
 
 # ---------------------------------------------------------------------------
+# A006: wire-seam registry drift
+
+_TRANSPORT_OK = (
+    "SEAM_METHODS = ('connector', 'dns_udp')\n\n\n"
+    'class Transport:\n'
+    '    def connector(self, backend):\n'
+    '        pass\n\n'
+    '    def dns_udp(self, resolver, port, payload, timeout_s):\n'
+    '        pass\n')
+
+_WIRETAP_OK = "SEAMS = ('connector', 'dns_udp')\n"
+
+
+def test_a006_matching_registries_clean(tmp_path):
+    assert _codes(tmp_path, {
+        'transport.py': _TRANSPORT_OK,
+        'wiretap.py': _WIRETAP_OK,
+    }) == set()
+
+
+def test_a006_seam_missing_from_transport(tmp_path):
+    vs = _run(tmp_path, {
+        'transport.py': _TRANSPORT_OK,
+        'wiretap.py': "SEAMS = ('connector', 'dns_udp', 'serve')\n",
+    })
+    assert [(Path(v.path).name, v.code) for v in vs] \
+        == [('wiretap.py', 'A006')]
+    assert '"serve"' in vs[0].msg
+
+
+def test_a006_method_missing_from_wiretap(tmp_path):
+    vs = _run(tmp_path, {
+        'transport.py': (
+            "SEAM_METHODS = ('connector', 'dns_udp', 'serve')\n\n\n"
+            'class Transport:\n'
+            '    def connector(self, backend):\n'
+            '        pass\n\n'
+            '    def dns_udp(self, resolver, port, payload, t):\n'
+            '        pass\n\n'
+            '    def serve(self, cb, host, port):\n'
+            '        pass\n'),
+        'wiretap.py': _WIRETAP_OK,
+    })
+    assert [(Path(v.path).name, v.code) for v in vs] \
+        == [('transport.py', 'A006')]
+    assert '"serve"' in vs[0].msg
+
+
+def test_a006_seam_not_a_transport_method(tmp_path):
+    # Registries agree, but the base class never grew the method:
+    # both the wiretap-side display AND the structural check fire on
+    # the transport.py registry line.
+    vs = _run(tmp_path, {
+        'transport.py': (
+            "SEAM_METHODS = ('connector', 'dns_udp')\n\n\n"
+            'class Transport:\n'
+            '    def connector(self, backend):\n'
+            '        pass\n'),
+        'wiretap.py': _WIRETAP_OK,
+    })
+    assert [(Path(v.path).name, v.code) for v in vs] \
+        == [('transport.py', 'A006')]
+    assert 'no such method' in vs[0].msg
+
+
+def test_a006_skipped_when_either_module_absent(tmp_path):
+    assert _codes(tmp_path, {'wiretap.py': _WIRETAP_OK}) == set()
+    assert _codes(tmp_path, {'transport.py': _TRANSPORT_OK}) == set()
+
+
+def test_a006_missing_registry_tuple_fires(tmp_path):
+    vs = _run(tmp_path, {
+        'transport.py': _TRANSPORT_OK,
+        'wiretap.py': 'x = 1\n',
+    })
+    assert [(Path(v.path).name, v.code) for v in vs] \
+        == [('wiretap.py', 'A006')]
+
+
+def test_a006_real_package_registries_agree():
+    # The actual repo must satisfy its own wire-seam drift rule.
+    _, vs = cbflow.analyze_paths([str(ROOT / 'cueball_tpu')])
+    assert [v for v in vs if v.code == 'A006'] == []
+
+
+# ---------------------------------------------------------------------------
 # Suppressions
 
 def test_suppression_per_code(tmp_path):

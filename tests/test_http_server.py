@@ -1,0 +1,110 @@
+"""Kang HTTP routing for the transport wire ledger: /kang/transport
+payload shape, the ?transport=/?seam= filters, and the malformed-param
+400-JSON convention (unknown parameter, unknown seam, unknown
+transport), driven through _route directly plus basic 404/405
+smoke."""
+
+import json
+
+import pytest
+
+from cueball_tpu import wiretap as mod_wiretap
+from cueball_tpu.http_server import _route
+
+
+@pytest.fixture(autouse=True)
+def _clean_wiretap():
+    yield
+    mod_wiretap.disable_wiretap()
+    mod_wiretap._lag_samplers.clear()
+    mod_wiretap._lag_disabled_reason = None
+
+
+def _get(path):
+    status, ctype, body = _route('GET', path, None)
+    assert ctype == 'application/json'
+    return status, json.loads(body)
+
+
+def test_transport_disabled_payload():
+    status, payload = _get('/kang/transport')
+    assert status == 200
+    assert payload['enabled'] is False
+    assert payload['transports'] == {}
+    assert payload['wire_ms'] == {}
+    assert 'p99_us' in payload['loop_lag']
+
+
+def test_transport_payload_and_filters():
+    led = mod_wiretap.enable_wiretap()
+    st = led.seam('asyncio', 'connector')
+    st.events += 4
+    st.bytes_out += 32
+    led.seam('fabric', 'dns_udp').events += 1
+    mod_wiretap.wire_wait('fabric', 2.5)
+
+    status, payload = _get('/kang/transport')
+    assert status == 200
+    assert payload['enabled'] is True
+    assert set(payload['transports']) == {'asyncio', 'fabric'}
+    assert payload['transports']['asyncio']['connector']['events'] == 4
+    assert payload['wire_ms']['fabric']['kernel_wait'] == 2.5
+
+    status, payload = _get('/kang/transport?transport=asyncio')
+    assert status == 200
+    assert set(payload['transports']) == {'asyncio'}
+
+    # The seam filter keeps only transports that fed that seam.
+    status, payload = _get('/kang/transport?seam=dns_udp')
+    assert status == 200
+    assert set(payload['transports']) == {'fabric'}
+    assert set(payload['transports']['fabric']) == {'dns_udp'}
+
+    status, payload = _get(
+        '/kang/transport?transport=asyncio&seam=connector')
+    assert status == 200
+    assert payload['transports'] \
+        == {'asyncio': {'connector': st.as_dict()}}
+
+
+def test_transport_unknown_parameter_is_400_json():
+    status, payload = _get('/kang/transport?verbose=1')
+    assert status == 400
+    assert payload == {'error': 'unknown parameter(s) verbose; '
+                                'supported: transport, seam'}
+    # Multiple unknowns are all named, sorted.
+    status, payload = _get('/kang/transport?b=1&a=2')
+    assert status == 400
+    assert payload['error'].startswith('unknown parameter(s) a, b')
+
+
+def test_transport_unknown_seam_is_400_json():
+    status, payload = _get('/kang/transport?seam=sendfile')
+    assert status == 400
+    assert payload['error'].startswith("unknown seam 'sendfile'")
+    for seam in mod_wiretap.SEAMS:
+        assert seam in payload['error']
+
+
+def test_transport_unknown_transport_is_400_json():
+    # Nothing active: the error names the (none) active set.
+    status, payload = _get('/kang/transport?transport=native')
+    assert status == 400
+    assert payload['error'] \
+        == "unknown transport 'native'; active: (none)"
+    # With an active transport, the error lists it.
+    led = mod_wiretap.enable_wiretap()
+    led.seam('asyncio', 'connector').events += 1
+    status, payload = _get('/kang/transport?transport=native')
+    assert status == 400
+    assert payload['error'] \
+        == "unknown transport 'native'; active: asyncio"
+
+
+def test_route_smoke_404_405():
+    status, _, body = _route('POST', '/kang/transport', None)
+    assert status == 405
+    assert json.loads(body) == {'error': 'GET only'}
+    status, _, body = _route('GET', '/kang/nope', None)
+    assert status == 404
+    assert json.loads(body) == {'error': 'not found'}

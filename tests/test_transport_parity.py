@@ -23,15 +23,20 @@ while parked, release-serves-waiter, and a full stop drain.
 import asyncio
 import random
 
+import pytest
+
 import cueball_tpu.fsm as mod_fsm
 from cueball_tpu import netsim
 from cueball_tpu import profile as mod_profile
 from cueball_tpu import trace as mod_trace
+from cueball_tpu import wiretap as mod_wiretap
 from cueball_tpu.cset import ConnectionSet
-from cueball_tpu.errors import ClaimTimeoutError
+from cueball_tpu.errors import (ClaimTimeoutError,
+                                TransportNotAvailableError)
 from cueball_tpu.pool import ConnectionPool
 from cueball_tpu.resolver import StaticIpResolver
-from cueball_tpu.transport import FabricTransport, get_transport
+from cueball_tpu.transport import (FabricTransport, NativeTransport,
+                                   get_transport)
 
 from conftest import run_async
 
@@ -215,19 +220,22 @@ def _run_arm(arm_name, soak, n_backends=1):
     rng_state = random.getstate()
     random.seed(0xC0EBA11)
     mod_trace.enable_tracing(ring_size=256, sample_rate=1.0)
+    mod_wiretap.enable_wiretap()
     try:
         run_async(main(), timeout=60)
         ledgers = mod_profile.phase_ledger()
+        wire = mod_wiretap.snapshot()
     finally:
+        mod_wiretap.disable_wiretap()
         mod_trace.disable_tracing()
         random.setstate(rng_state)
-    return events, ledgers
+    return events, ledgers, wire
 
 
 def _assert_parity(asy, fab):
     """The gate: byte-identical transition traces, matching ledgers."""
-    asy_events, asy_ledgers = asy
-    fab_events, fab_ledgers = fab
+    asy_events, asy_ledgers, asy_wire = asy
+    fab_events, fab_ledgers, fab_wire = fab
     assert len(asy_events) > 40   # the soak actually drove the FSMs
     assert asy_events == fab_events
     # Matching ledgers: same claims in the same order with the same
@@ -239,6 +247,29 @@ def _assert_parity(asy, fab):
     for ledgers in (asy_ledgers, fab_ledgers):
         summary = mod_profile.ledger_summary(ledgers)
         assert summary['coverage'] >= 0.95, summary
+        # Per-claim wire identity: the socket_wait decomposition is
+        # exact under plain float addition, claim by claim.
+        for led in ledgers:
+            assert sum(led['wire'].values()) \
+                == led['phases']['socket_wait'], led
+    _assert_wire_parity(asy_wire.get('asyncio', {}),
+                        fab_wire.get('fabric', {}))
+
+
+def _assert_wire_parity(asy_seams, fab_seams):
+    """TransportLedger parity: the same soak over either transport
+    must feed the wire ledger the same per-seam event counts and byte
+    totals (PARITY_FIELDS excludes the wall-clock latency fields and
+    the known closes divergence — see docs/transport.md)."""
+    assert asy_seams, 'asyncio arm recorded no wire-ledger seams'
+    assert set(asy_seams) == set(fab_seams)
+    assert asy_seams['connector']['events'] > 0   # anti-vacuity
+    for seam in sorted(asy_seams):
+        for field in mod_wiretap.PARITY_FIELDS:
+            assert asy_seams[seam][field] == fab_seams[seam][field], \
+                'wire ledger drift at %s.%s: asyncio=%r fabric=%r' % (
+                    seam, field, asy_seams[seam][field],
+                    fab_seams[seam][field])
 
 
 def test_pool_soak_parity_asyncio_vs_fabric():
@@ -249,3 +280,46 @@ def test_pool_soak_parity_asyncio_vs_fabric():
 def test_cset_soak_parity_asyncio_vs_fabric():
     _assert_parity(_run_arm('asyncio', _cset_soak, n_backends=2),
                    _run_arm('fabric', _cset_soak, n_backends=2))
+
+
+# ---------------------------------------------------------------------------
+# NativeTransport: registered but unavailable, typed errors per seam
+
+
+def test_native_transport_every_seam_raises_typed_error():
+    t = NativeTransport()
+    with pytest.raises(TransportNotAvailableError) as ei:
+        t.connector({'address': '127.0.0.1', 'port': 1})
+    assert ei.value.seam == 'connector'
+    assert ei.value.transport == 'native'
+
+    async def drive(coro_fn, *args):
+        with pytest.raises(TransportNotAvailableError) as ei:
+            await coro_fn(*args)
+        return ei.value
+
+    async def main():
+        out = {}
+        out['create_stream'] = await drive(
+            t.create_stream, lambda: None, '127.0.0.1', 1)
+        out['serve'] = await drive(t.serve, lambda r, w: None,
+                                   '127.0.0.1', 0)
+        out['dns_udp'] = await drive(t.dns_udp, '127.0.0.1', 53,
+                                     b'x', 1.0)
+        out['dns_tcp'] = await drive(t.dns_tcp, '127.0.0.1', 53,
+                                     b'x', 1.0)
+        return out
+
+    errs = run_async(main(), timeout=10)
+    for seam, err in errs.items():
+        assert err.seam == seam
+        assert err.transport == 'native'
+        assert 'not available' in str(err)
+
+
+def test_get_transport_native_refuses_at_resolution():
+    with pytest.raises(TransportNotAvailableError) as ei:
+        get_transport('native')
+    assert ei.value.seam == 'resolve'
+    assert ei.value.transport == 'native'
+    assert 'register_transport' in str(ei.value)

@@ -1,0 +1,398 @@
+"""Transport wire ledger (cueball_tpu/wiretap.py): seam registry,
+enable/disable lifecycle, connect decomposition arithmetic (clamping,
+exact-sum identity, breakdown retention), the loop-lag sampler
+(refusal under a non-system clock, collection on a real loop), metrics
+publication + merge_expositions folding, the fleet merge shapes, the
+SIGUSR2 dump section, and the FleetSampler loop_lag_p99_us column."""
+
+import asyncio
+
+import pytest
+
+from cueball_tpu import metrics as mod_metrics
+from cueball_tpu import profile as mod_profile
+from cueball_tpu import trace as mod_trace
+from cueball_tpu import transport as mod_transport
+from cueball_tpu import utils as mod_utils
+from cueball_tpu import wiretap as mod_wiretap
+from cueball_tpu.pool import ConnectionPool
+from cueball_tpu.resolver import StaticIpResolver
+
+from conftest import run_async
+
+
+@pytest.fixture(autouse=True)
+def _clean_wiretap():
+    yield
+    mod_wiretap.disable_wiretap()
+    mod_wiretap.stop_loop_lag_sampler()
+    mod_wiretap._lag_samplers.clear()
+    mod_wiretap._lag_disabled_reason = None
+
+
+# ---------------------------------------------------------------------------
+# Registry and lifecycle
+
+def test_seams_mirror_transport_seam_methods():
+    # The cross-module contract cbflow A006 pins statically, asserted
+    # at runtime too: same names, same order irrelevant, and every
+    # seam is a real method on the Transport base class.
+    assert set(mod_wiretap.SEAMS) == set(mod_transport.SEAM_METHODS)
+    for seam in mod_wiretap.SEAMS:
+        assert callable(getattr(mod_transport.Transport, seam))
+
+
+def test_enable_disable_lifecycle():
+    assert not mod_wiretap.wiretap_enabled()
+    assert mod_wiretap.seam_stats('asyncio', 'connector') is None
+    led = mod_wiretap.enable_wiretap()
+    assert mod_wiretap.enable_wiretap() is led       # idempotent
+    assert mod_wiretap.wiretap_enabled()
+    st = mod_wiretap.seam_stats('asyncio', 'connector')
+    assert st is mod_wiretap.seam_stats('asyncio', 'connector')
+    assert mod_wiretap.disable_wiretap() is True
+    assert mod_wiretap.disable_wiretap() is False
+    assert mod_wiretap.seam_stats('asyncio', 'connector') is None
+
+
+def test_unknown_seam_rejected():
+    led = mod_wiretap.enable_wiretap()
+    with pytest.raises(ValueError):
+        led.seam('asyncio', 'sendfile')
+
+
+def test_snapshot_shape():
+    led = mod_wiretap.enable_wiretap()
+    st = led.seam('fabric', 'dns_udp')
+    st.events += 2
+    st.bytes_out += 64
+    snap = mod_wiretap.snapshot()
+    assert snap == {'fabric': {'dns_udp': st.as_dict()}}
+    assert snap['fabric']['dns_udp']['events'] == 2
+    assert set(st.as_dict()) == set(mod_wiretap.SeamStats.__slots__)
+    assert set(mod_wiretap.PARITY_FIELDS) < set(st.as_dict())
+
+
+# ---------------------------------------------------------------------------
+# Connect decomposition
+
+def test_record_connect_splits_span_by_marks():
+    mod_wiretap.enable_wiretap()
+    # start=100, ready=106, dispatched=108, end=110.
+    mod_wiretap.record_connect('asyncio', 100.0, 110.0, (106.0, 108.0))
+    tot = mod_wiretap.wire_totals()['asyncio']
+    assert tot == {'kernel_wait': 6.0, 'loop_dispatch': 2.0,
+                   'proto_parse': 2.0}
+    assert mod_wiretap.connect_breakdown(100.0, 110.0) \
+        == (6.0, 2.0, 2.0)
+    # Unknown span -> None.
+    assert mod_wiretap.connect_breakdown(1.0, 2.0) is None
+
+
+def test_record_connect_clamps_marks_into_span():
+    mod_wiretap.enable_wiretap()
+    # Marks outside [start, end] (clock skew between the protocol
+    # stamp and the FSM span) clamp rather than going negative.
+    mod_wiretap.record_connect('asyncio', 100.0, 110.0, (90.0, 200.0))
+    tot = mod_wiretap.wire_totals()['asyncio']
+    assert tot['kernel_wait'] == 0.0
+    assert tot['loop_dispatch'] == 10.0
+    assert tot['proto_parse'] == 0.0
+    assert sum(tot.values()) == 10.0
+
+
+def test_record_connect_without_marks_is_all_kernel():
+    mod_wiretap.enable_wiretap()
+    mod_wiretap.record_connect('fabric', 50.0, 57.5, None)
+    assert mod_wiretap.wire_totals()['fabric'] \
+        == {'kernel_wait': 7.5, 'loop_dispatch': 0.0,
+            'proto_parse': 0.0}
+
+
+def test_wire_wait_accumulates_kernel_only():
+    mod_wiretap.enable_wiretap()
+    mod_wiretap.wire_wait('fabric', 12.5)
+    mod_wiretap.wire_wait('fabric', 0.0)       # no-op
+    mod_wiretap.wire_wait('fabric', -1.0)      # no-op
+    assert mod_wiretap.wire_totals()['fabric']['kernel_wait'] == 12.5
+
+
+def test_breakdown_retention_evicts_oldest(monkeypatch):
+    monkeypatch.setattr(mod_wiretap, '_BREAKDOWN_CAP', 3)
+    mod_wiretap.enable_wiretap()
+    for i in range(5):
+        mod_wiretap.record_connect('asyncio', float(i), float(i) + 1.0,
+                                   None)
+    assert mod_wiretap.connect_breakdown(0.0, 1.0) is None
+    assert mod_wiretap.connect_breakdown(1.0, 2.0) is None
+    for i in (2, 3, 4):
+        assert mod_wiretap.connect_breakdown(float(i), float(i) + 1.0) \
+            == (1.0, 0.0, 0.0)
+
+
+def test_disabled_forwarders_are_noops():
+    mod_wiretap.record_connect('asyncio', 0.0, 1.0, None)
+    mod_wiretap.wire_wait('asyncio', 5.0)
+    assert mod_wiretap.connect_breakdown(0.0, 1.0) is None
+    assert mod_wiretap.snapshot() == {}
+    assert mod_wiretap.wire_totals() == {}
+
+
+# ---------------------------------------------------------------------------
+# watch() and instrument_writer()
+
+class _FakeEmitter:
+    def __init__(self):
+        self.listeners = {}
+
+    def on(self, event, fn):
+        self.listeners.setdefault(event, []).append(fn)
+        return fn
+
+    def emit(self, event, *args):
+        for fn in list(self.listeners.get(event, [])):
+            fn(*args)
+
+
+def test_watch_counts_outcomes_with_internal_listeners():
+    led = mod_wiretap.enable_wiretap()
+    st = led.seam('asyncio', 'connector')
+    conn = _FakeEmitter()
+    mod_wiretap.watch(st, conn)
+    # Framework-internal marking: the claim-handle leak detector and
+    # the listener mutation epoch must ignore these.
+    for fns in conn.listeners.values():
+        assert all(getattr(fn, '_cueball_internal', False)
+                   for fn in fns)
+    conn.emit('connect')
+    conn.emit('error', RuntimeError('x'))
+    conn.emit('close')
+    conn.emit('close')
+    assert (st.connects, st.errors, st.closes) == (1, 1, 2)
+
+
+class _FakeTransport:
+    def __init__(self):
+        self.depth = 0
+
+    def get_write_buffer_size(self):
+        return self.depth
+
+
+class _FakeWriter:
+    def __init__(self):
+        self.transport = _FakeTransport()
+        self.chunks = []
+
+    def write(self, data):
+        self.chunks.append(data)
+        self.transport.depth += len(data)
+
+
+def test_instrument_writer_counts_and_highwater():
+    led = mod_wiretap.enable_wiretap()
+    st = led.seam('asyncio', 'connector')
+    writer = _FakeWriter()
+    mod_wiretap.instrument_writer(st, writer)
+    writer.write(b'abcd')
+    writer.write(b'ef')
+    assert writer.chunks == [b'abcd', b'ef']   # bytes still flow
+    assert st.writes == 2
+    assert st.bytes_out == 6
+    assert st.buf_highwater == 6
+
+
+# ---------------------------------------------------------------------------
+# Loop-lag sampler
+
+def test_lag_sampler_refuses_non_system_clock():
+    class _FrozenClock:
+        def monotonic(self):
+            return 0.0
+
+        def wall(self):
+            return 0.0
+
+    old = mod_utils.set_clock(_FrozenClock())
+    try:
+        async def main():
+            return mod_wiretap.start_loop_lag_sampler()
+        assert run_async(main(), timeout=10) is False
+    finally:
+        mod_utils.set_clock(old)
+    stats = mod_wiretap.loop_lag_stats()
+    assert stats['disabled_reason'] \
+        == 'non-system clock installed (netsim?)'
+    assert stats['running'] is False
+
+
+def test_lag_sampler_refuses_without_running_loop():
+    assert mod_wiretap.start_loop_lag_sampler() is False
+    assert mod_wiretap.loop_lag_stats()['disabled_reason'] \
+        == 'no running event loop'
+
+
+def test_lag_sampler_collects_on_real_loop():
+    async def main():
+        assert mod_wiretap.start_loop_lag_sampler(interval_ms=5.0)
+        assert mod_wiretap.start_loop_lag_sampler()   # idempotent
+        await asyncio.sleep(0.1)
+        stats = mod_wiretap.loop_lag_stats()
+        p99 = mod_wiretap.loop_lag_p99_us()
+        assert mod_wiretap.stop_loop_lag_sampler() is True
+        return stats, p99
+
+    stats, p99 = run_async(main(), timeout=30)
+    assert stats['running'] is True
+    assert stats['disabled_reason'] is None
+    assert stats['samples'] >= 3
+    assert stats['max_us'] >= stats['p99_us'] >= stats['p50_us'] >= 0.0
+    assert p99 >= 0.0
+    assert mod_wiretap.stop_loop_lag_sampler() is False
+
+
+def test_loop_lag_p99_zero_when_unarmed():
+    assert mod_wiretap.loop_lag_p99_us() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Metrics publication
+
+def test_metrics_publish_and_merge():
+    coll = mod_metrics.create_collector()
+    led = mod_wiretap.enable_wiretap(collector=coll)
+    st = led.seam('asyncio', 'connector')
+    st.events += 3
+    st.bytes_in += 10
+    st.bytes_out += 20
+    mod_wiretap.record_connect('asyncio', 0.0, 4.0, (1.0, 3.0))
+    text = coll.collect()
+    assert 'cueball_transport_events{seam="connector",' \
+           'transport="asyncio"} 3' in text
+    assert 'direction="in"' in text and 'direction="out"' in text
+    assert 'cueball_transport_dispatch_lag_ms_count' in text
+    # Fleet scrape: two children's payloads fold — histogram counts
+    # sum, gauge rows concatenate without duplicate family headers.
+    merged = mod_metrics.merge_expositions([text, text])
+    assert merged.count('# TYPE cueball_transport_dispatch_lag_ms') == 1
+    for line in merged.splitlines():
+        if line.startswith('cueball_transport_dispatch_lag_ms_count'):
+            assert line.rsplit(' ', 1)[1] == '2'
+    # Disable unhooks the publisher: a fresh scrape stops refreshing.
+    mod_wiretap.disable_wiretap()
+    assert led._publish not in coll._hooks
+
+
+# ---------------------------------------------------------------------------
+# claim_ledger decomposition on a real asyncio loopback pool
+
+def test_claim_ledger_decomposes_socket_wait_on_real_pool():
+    mod_wiretap.enable_wiretap()
+    mod_trace.enable_tracing(ring_size=64, sample_rate=1.0)
+    try:
+        async def main():
+            server = await asyncio.start_server(
+                lambda r, w: None, '127.0.0.1', 0)
+            res = StaticIpResolver({'backends': [{
+                'address': '127.0.0.1',
+                'port': server.sockets[0].getsockname()[1]}]})
+            pool = ConnectionPool({
+                'domain': 'wiretap.test',
+                'transport': 'asyncio',
+                'resolver': res,
+                'spares': 1,
+                'maximum': 1,
+                'recovery': {'default': {
+                    'retries': 1, 'timeout': 2000, 'delay': 10,
+                    'maxDelay': 50, 'delaySpread': 0}},
+            })
+            res.start()
+            fut = asyncio.get_running_loop().create_future()
+            pool.claim_cb({'timeout': 30000.0},
+                          lambda e, h=None, c=None:
+                          fut.done() or fut.set_result((e, h)))
+            err, hdl = await fut
+            assert err is None
+            hdl.release()
+            pool.stop()
+            while not pool.is_in_state('stopped'):
+                await asyncio.sleep(0.005)
+            res.stop()
+            await asyncio.sleep(0.05)
+
+        run_async(main(), timeout=30)
+        ledgers = mod_profile.phase_ledger()
+    finally:
+        mod_trace.disable_tracing()
+        mod_wiretap.disable_wiretap()
+    assert ledgers
+    # The cold-pool claim waited out the slot's connect: its
+    # socket_wait is decomposed from real wire marks, exactly.
+    decomposed = [led for led in ledgers if led['wire_decomposed']]
+    assert decomposed, ledgers
+    for led in ledgers:
+        assert set(led['wire']) == set(mod_wiretap.SUB_PHASES)
+        assert sum(led['wire'].values()) \
+            == led['phases']['socket_wait'], led
+        assert all(v >= 0.0 for v in led['wire'].values())
+    summary = mod_profile.ledger_summary(ledgers)
+    assert summary['wire_claims'] == len(decomposed)
+    assert summary['wire_ms']['kernel_wait'] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Fleet merge + dump
+
+def test_wiretap_record_and_reduce_shapes():
+    mod_wiretap.enable_wiretap()
+    rec = mod_wiretap.wiretap_record(shard=3)
+    assert rec['shard'] == 3 and rec['enabled'] is True
+    assert 'p99_us' in rec['loop_lag']
+    rec2 = dict(rec, shard=4)
+    rec2['loop_lag'] = dict(rec['loop_lag'], p99_us=120.0, samples=7)
+    out = mod_wiretap.reduce_wiretap([rec, rec2, None])
+    assert out['n_shards'] == 2
+    assert out['loop_lag_p99_us'] == 120.0
+    assert out['loop_lag_samples'] == rec['loop_lag']['samples'] + 7
+    assert out['shards'] == [rec, rec2]
+    assert out['transports'] == mod_wiretap.snapshot()
+
+
+def test_reduce_wiretap_empty():
+    out = mod_wiretap.reduce_wiretap([])
+    assert out['n_shards'] == 0
+    assert out['loop_lag_p99_us'] == 0.0
+
+
+def test_dump_wiretap_absent_then_sectioned():
+    assert mod_wiretap.dump_wiretap() == ''
+    led = mod_wiretap.enable_wiretap()
+    st = led.seam('fabric', 'connector')
+    st.events += 1
+    st.connects += 1
+    mod_wiretap.wire_wait('fabric', 3.25)
+    text = mod_wiretap.dump_wiretap()
+    assert text.startswith('-- transport wire ledger --')
+    assert 'wiretap: enabled' in text
+    assert 'fabric/connector: events=1 connects=1' in text
+    assert 'wire fabric: kernel_wait=3.2ms' in text
+
+
+# ---------------------------------------------------------------------------
+# FleetSampler column
+
+def test_fleet_gauges_include_loop_lag_column():
+    sampler = pytest.importorskip('cueball_tpu.parallel.sampler')
+    assert 'loop_lag_p99_us' in sampler._FLEET_GAUGES
+
+
+def test_reduce_fleet_takes_worst_shard_loop_lag():
+    sampler = pytest.importorskip('cueball_tpu.parallel.sampler')
+    base = {name: 0.0 for name in sampler._FLEET_GAUGES}
+    a = dict(base, n_pools=2.0, loop_lag_p99_us=50.0)
+    b = dict(base, n_pools=1.0, loop_lag_p99_us=900.0)
+    out = sampler.reduce_fleet([a, b])
+    # Worst shard wins: a fleet-weighted mean would bury the one
+    # saturated loop (2/3 weight on the healthy shard).
+    assert out['loop_lag_p99_us'] == 900.0
+    assert out['n_pools'] == 3.0

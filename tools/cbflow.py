@@ -57,6 +57,13 @@ tests/test_cbflow.py):
         ``_prof`` and read it; every module defining ``_prof`` must
         be in the registry (else the sampler never binds it); and
         every function pushing a phase must pop it in a ``finally``.
+- A006  wire-seam registry drift — the transport wire ledger
+        (wiretap.py) attributes bytes and syscalls per seam by name:
+        ``wiretap.SEAMS`` and ``transport.SEAM_METHODS`` must agree
+        exactly (two-way), and every registered seam must be a method
+        on the ``Transport`` base class — a seam added to one side
+        only would silently record nothing (or count a method the
+        ledger can never display).
 - U001  unused suppression (``--audit-suppressions``) — a
         ``# cbflint/cbfsm/cbflow: ignore`` comment whose rule no
         longer fires on its line fails the build, so the suppression
@@ -91,6 +98,7 @@ CODES = {
     'A003': 'raw clock/RNG read outside the utils seams',
     'A004': 'fire-and-forget coroutine / dropped task',
     'A005': 'phase-seam coverage break',
+    'A006': 'wire-seam registry drift',
     'U001': 'suppression whose rule never fires',
 }
 
@@ -674,6 +682,74 @@ def _check_push_pop(info: ModuleInfo, collect) -> None:
 
 
 # ---------------------------------------------------------------------------
+# A006: wire-seam registry drift (program-level)
+
+
+def _class_methods(info: ModuleInfo, class_name: str) -> set[str]:
+    """Names of methods defined directly on ``class_name`` in
+    ``info`` (sync and async)."""
+    for node in info.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {f.name for f in node.body
+                    if isinstance(f, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    return set()
+
+
+def _check_wire_seams(program: Program, collect) -> None:
+    """Two-way drift check between ``wiretap.SEAMS`` (what the ledger
+    can account) and ``transport.SEAM_METHODS`` (what the backends
+    implement), plus the structural fact that every registered seam is
+    a method on the ``Transport`` base class. Runs only when both
+    modules are in the scanned set (same scoping as A005)."""
+    wt = program.files.get('wiretap.py')
+    tr = program.files.get('transport.py')
+    if wt is None or tr is None:
+        return
+    seams = _extract_str_tuple(wt.tree, 'SEAMS')
+    methods = _extract_str_tuple(tr.tree, 'SEAM_METHODS')
+    if seams is None:
+        if not is_suppressed(wt.sup, 1, 'A006'):
+            collect(Violation(
+                wt.path, 1, 'A006',
+                'wiretap.py defines no module-level SEAMS tuple: the '
+                'wire ledger has no seam registry to validate against'))
+        return
+    if methods is None:
+        if not is_suppressed(tr.sup, 1, 'A006'):
+            collect(Violation(
+                tr.path, 1, 'A006',
+                'transport.py defines no module-level SEAM_METHODS '
+                'tuple: wiretap.SEAMS has nothing to agree with'))
+        return
+    seam_names = {s for s, _ in seams}
+    method_names = {m for m, _ in methods}
+    for name, lineno in seams:
+        if name not in method_names:
+            if not is_suppressed(wt.sup, lineno, 'A006'):
+                collect(Violation(
+                    wt.path, lineno, 'A006',
+                    'wiretap.SEAMS names "%s" but transport.'
+                    'SEAM_METHODS does not: the ledger shows a seam '
+                    'no backend ever feeds' % name))
+    transport_methods = _class_methods(tr, 'Transport')
+    for name, lineno in methods:
+        if name not in seam_names:
+            if not is_suppressed(tr.sup, lineno, 'A006'):
+                collect(Violation(
+                    tr.path, lineno, 'A006',
+                    'transport.SEAM_METHODS names "%s" but wiretap.'
+                    'SEAMS does not: bytes on that seam are '
+                    'unaccountable' % name))
+        if transport_methods and name not in transport_methods:
+            if not is_suppressed(tr.sup, lineno, 'A006'):
+                collect(Violation(
+                    tr.path, lineno, 'A006',
+                    'SEAM_METHODS names "%s" but the Transport base '
+                    'class defines no such method' % name))
+
+
+# ---------------------------------------------------------------------------
 # Driving
 
 
@@ -717,6 +793,7 @@ def analyze_program(program: Program,
             info = program.files[rel]
             _FlowVisitor(program, info, out.append).visit(info.tree)
         _check_seams(program, out.append)
+        _check_wire_seams(program, out.append)
     finally:
         if raw:
             for info, sup in saved:
