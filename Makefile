@@ -5,7 +5,8 @@ LINT_TARGETS = cueball_tpu tests bench.py __graft_entry__.py tools \
 	examples bin/cbresolve
 
 .PHONY: test check lint bench bench-host bench-sharded bench-control \
-	bench-health bench-profile bench-transport profile dryrun \
+	bench-health bench-profile bench-transport bench-native \
+	profile dryrun \
 	coverage native native-sanitize ci docs docs-check fsm-graph \
 	scenarios scenarios-fast
 
@@ -14,18 +15,23 @@ native:
 
 # ASan+UBSan gate for the C core (docs/static-analysis.md §Native
 # sanitizers): rebuild the extension instrumented, run the native
-# test suite with libasan preloaded (the interpreter is not
-# ASan-built, so the runtime must come in via LD_PRELOAD;
-# detect_leaks=0 because CPython's own arena allocations never
-# free at exit), then restore the normal -O2 build. --force on both
-# builds: setuptools only mtime-compares sources, a flags-only
+# test suites — the trace/profile engine AND the transport data
+# plane, whose C thread frees completion payloads and ops off-GIL
+# (exactly the lifetime bugs ASan exists to catch), plus the
+# transport parity suite's native arm — with libasan preloaded (the
+# interpreter is not ASan-built, so the runtime must come in via
+# LD_PRELOAD; detect_leaks=0 because CPython's own arena allocations
+# never free at exit), then restore the normal -O2 build. --force on
+# both builds: setuptools only mtime-compares sources, a flags-only
 # change would silently reuse the stale object.
 native-sanitize:
 	CUEBALL_SANITIZE=1 $(PYTHON) native/build.py
 	LD_PRELOAD=$$(gcc -print-file-name=libasan.so) \
 	ASAN_OPTIONS=detect_leaks=0 \
 	UBSAN_OPTIONS=print_stacktrace=1:halt_on_error=1 \
-	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_native.py -q \
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_native.py \
+		tests/test_native_transport.py \
+		tests/test_transport_parity.py -q \
 		-p no:cacheprovider
 	CUEBALL_BUILD_FORCE=1 $(PYTHON) native/build.py
 
@@ -123,6 +129,15 @@ bench-health:
 # flamegraph identity receipt. One JSON line.
 bench-profile:
 	$(PYTHON) bench.py --profile-only
+
+# Native transport data-plane stage alone (docs/transport.md §Native
+# backend): the asyncio-vs-native interleaved A/B on the
+# transport-bound claim path — a bulk-lease arm (frames x 8 KiB per
+# claim, with phase-ledger receipts per arm) and a small-frame arm —
+# recording claim_release_native_ops_per_sec and both
+# native-vs-asyncio ratios. One JSON line.
+bench-native: native
+	$(PYTHON) bench.py --native-only
 
 # Transport wire-ledger stage alone (docs/transport.md §Wire ledger):
 # the wiretap-off/on claim-path A/B over the real asyncio transport

@@ -18,6 +18,7 @@ Secondary fields: raw claim/release hot-path throughput on a saturated
 import asyncio
 import json
 import os
+import sys
 import time
 
 TARGETS = [300, 500, 1000, 1500, 2000, 2500, 5000]
@@ -533,6 +534,370 @@ async def bench_claim_many(batch=CLAIM_MANY_BATCH,
                      'speed-gated with degraded rounds redone') % (
             trials, batches, batch),
     }
+
+
+# Batch-size sweep around the claim_many stage: the committed batch=64
+# arm stays the headline/gated figure; the 16 and 256 arms bound the
+# amortization curve (how fast the per-claim overhead win saturates)
+# with fewer trials each — they are context, not gates.
+CLAIM_MANY_SWEEP = (16, 64, 256)
+CLAIM_MANY_SWEEP_TRIALS = 3
+
+
+async def bench_claim_many_sweep(batch_sizes=CLAIM_MANY_SWEEP):
+    """bench_claim_many at each batch size; ~8000 handles per trial at
+    every size (batches scales inversely) so the arms are directly
+    comparable. Returns {str(batch): stage-record}; the batch=64 entry
+    is the full-trial headline arm."""
+    out = {}
+    for b in batch_sizes:
+        trials = CLAIM_MANY_TRIALS if b == CLAIM_MANY_BATCH \
+            else CLAIM_MANY_SWEEP_TRIALS
+        out[str(b)] = await bench_claim_many(
+            batch=b, batches=max(1, CLAIM_MANY_BATCH
+                                 * CLAIM_MANY_BATCHES_PER_TRIAL // b),
+            trials=trials)
+    return out
+
+
+# Native transport A/B: the tentpole's receipt. Unlike every other
+# claim stage (InstantConnection, no bytes moved), this one is
+# transport-BOUND: each claim moves real bytes over real loopback
+# sockets, so the arms measure the data plane — asyncio's per-fd
+# protocol machinery on the loop thread vs the C plane's off-loop
+# readiness loop with batched completion delivery. Two honest arms:
+# 'bulk' (8 x 8 KiB frames per lease — the buffered-write /
+# C-side-read-assembly regime the plane is built for, and the
+# headline number) and 'small' (one 64 B frame per lease — the
+# latency-bound regime where the extra completion hop COSTS; the
+# record keeps it so the tradeoff stays visible instead of
+# cherry-picked away).
+NATIVE_AB_BULK = {'payload_bytes': 8192, 'frames_per_claim': 8,
+                  'ops': 1500, 'concurrency': 64}
+NATIVE_AB_SMALL = {'payload_bytes': 64, 'frames_per_claim': 1,
+                   'ops': 6000, 'concurrency': 32}
+NATIVE_AB_OPS_PER_TRIAL = 6000
+NATIVE_AB_CONCURRENCY = 32
+NATIVE_AB_TRIALS = 5
+NATIVE_AB_PAYLOAD = 64
+NATIVE_AB_RECEIPT_OPS = 400
+
+
+_ECHO_SERVER_SRC = r'''
+import selectors, socket, sys
+srv = socket.create_server(("127.0.0.1", 0))
+srv.setblocking(False)
+sys.stdout.write("%d\n" % srv.getsockname()[1])
+sys.stdout.flush()
+sel = selectors.DefaultSelector()
+sel.register(srv, selectors.EVENT_READ, "accept")
+sel.register(sys.stdin, selectors.EVENT_READ, "stop")
+pending = {}
+running = True
+while running:
+    for key, ev in sel.select():
+        if key.data == "stop":
+            running = False
+            break
+        if key.data == "accept":
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                continue
+            c.setblocking(False)
+            c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            pending[c] = b""
+            sel.register(c, selectors.EVENT_READ, "conn")
+            continue
+        c = key.fileobj
+        buf = pending.get(c, b"")
+        if ev & selectors.EVENT_READ:
+            try:
+                data = c.recv(262144)
+            except BlockingIOError:
+                data = None
+            except OSError:
+                data = b""
+            if data == b"":
+                sel.unregister(c)
+                del pending[c]
+                c.close()
+                continue
+            if data:
+                buf += data
+        while buf:
+            try:
+                n = c.send(buf)
+            except BlockingIOError:
+                break
+            except OSError:
+                buf = b""
+                break
+            buf = buf[n:]
+        pending[c] = buf
+        want = selectors.EVENT_READ
+        if buf:
+            want |= selectors.EVENT_WRITE
+        sel.modify(c, want, "conn")
+'''
+
+
+def _start_echo_server():
+    """Echo server in a SUBPROCESS (not a thread: an in-process Python
+    echo loop steals GIL time from both arms and caps exactly the
+    resource the native plane is supposed to free up). The child
+    prints its port on stdout; closing its stdin stops it. Returns
+    (port, stop_callable)."""
+    import subprocess
+    proc = subprocess.Popen(
+        [sys.executable, '-c', _ECHO_SERVER_SRC],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+    port = int(proc.stdout.readline())
+
+    def stop():
+        try:
+            proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        proc.stdout.close()
+
+    return port, stop
+
+
+async def _native_ab_echo(conn, payload, frames=1):
+    """One lease's worth of echo traffic through whichever connection
+    contract the arm's transport produced (NativeConnection's
+    write/read_exactly vs TcpStreamConnection's reader/writer pair).
+    All `frames` writes go out before the reads so the lease is
+    pipelined — one response-sized read at the end, the shape a bulk
+    fetch actually has."""
+    total = len(payload) * frames
+    read_exactly = getattr(conn, 'read_exactly', None)
+    if read_exactly is not None:
+        for _ in range(frames):
+            conn.write(payload)
+        got = await read_exactly(total, 10_000.0)
+    else:
+        for _ in range(frames):
+            conn.writer.write(payload)
+        got = await conn.reader.readexactly(total)
+    assert len(got) == total and got[:len(payload)] == payload
+
+
+async def bench_native_transport_ab(ops=NATIVE_AB_OPS_PER_TRIAL,
+                                    trials=NATIVE_AB_TRIALS,
+                                    concurrency=NATIVE_AB_CONCURRENCY,
+                                    payload_bytes=NATIVE_AB_PAYLOAD,
+                                    frames_per_claim=1,
+                                    with_receipts=True):
+    """asyncio-vs-native transport A/B on the transport-bound claim
+    path: a `concurrency`-slot pool over real loopback sockets, every
+    claim doing one echo roundtrip before release, `concurrency`
+    claim chains outstanding. The arms STRICTLY alternate per round
+    (asyncio, native, asyncio, ...) on fresh pools so host drift
+    cancels out of the ratio; same GC/speed-gate discipline as the
+    other claim stages. Each arm also runs one untimed fully-traced
+    receipt window whose phase-ledger summary (fsm/runq/socket_wait
+    decomposition) and flamegraph ride home in the record — the
+    receipt that the native arm's socket_wait actually shrank rather
+    than moving to `other`."""
+    import gc
+    import statistics
+    from cueball_tpu import native_transport as mod_nt
+    from cueball_tpu import profile as mod_profile
+    from cueball_tpu import trace as mod_trace
+    from cueball_tpu.pool import ConnectionPool
+    from cueball_tpu.resolver import StaticIpResolver
+
+    if not mod_nt.native_available():
+        return {'skipped': 'native extension not available'}
+
+    port, stop_echo = _start_echo_server()
+    backends = [{'address': '127.0.0.1', 'port': port}]
+    payload = (bytes(range(256))
+               * ((payload_bytes + 255) // 256))[:payload_bytes]
+
+    async def fresh_pool(transport_name):
+        res = StaticIpResolver({'backends': backends})
+        pool = ConnectionPool({
+            'domain': 'bench.native', 'transport': transport_name,
+            'resolver': res, 'spares': concurrency,
+            'maximum': concurrency,
+            'recovery': {'default': {'timeout': 5000, 'retries': 3,
+                                     'delay': 100}}})
+        res.start()
+        await settle(pool)
+        deadline = asyncio.get_running_loop().time() + 30.0
+        while len(pool.p_idleq) < concurrency:
+            if asyncio.get_running_loop().time() > deadline:
+                raise RuntimeError(
+                    '%s pool never grew to %d idle slots (%d)' % (
+                        transport_name, concurrency,
+                        len(pool.p_idleq)))
+            await asyncio.sleep(0.005)
+        return res, pool
+
+    async def stop_pool(res, pool):
+        pool.stop()
+        while not pool.is_in_state('stopped'):
+            await asyncio.sleep(0.01)
+        res.stop()
+
+    async def run_ops(pool, n):
+        remaining = [n]
+
+        async def worker():
+            while remaining[0] > 0:
+                remaining[0] -= 1
+                hdl, conn = await pool.claim({'timeout': 10000})
+                await _native_ab_echo(conn, payload,
+                                      frames_per_claim)
+                hdl.release()
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker()
+                               for _ in range(concurrency)])
+        return n / (time.perf_counter() - t0)
+
+    async def timed_trial(transport_name):
+        res, pool = await fresh_pool(transport_name)
+        gc.collect()
+        await speed_gate()
+        gc.disable()
+        rate = await run_ops(pool, ops)
+        gc.enable()
+        clean = _speed_ok(_speed_probe())
+        await stop_pool(res, pool)
+        return rate, clean
+
+    async def receipt_trial(transport_name):
+        # Untimed fully-traced window: the phase-attribution receipt.
+        res, pool = await fresh_pool(transport_name)
+        mod_trace.enable_tracing(ring_size=PROFILE_TABLE_RING,
+                                 sample_rate=1.0)
+        try:
+            await run_ops(pool, NATIVE_AB_RECEIPT_OPS)
+            await asyncio.sleep(0.05)   # deferred trace events drain
+            summary = mod_profile.ledger_summary(
+                mod_profile.phase_ledger())
+            flame = mod_profile.flamegraph()
+        finally:
+            mod_trace.disable_tracing()
+        await stop_pool(res, pool)
+        return {
+            'claims': summary['claims'],
+            'wall_ms': round(summary['wall_ms'], 3),
+            'phase_ms': {p: round(ms, 3)
+                         for p, ms in summary['phase_ms'].items()},
+            'coverage': round(summary['coverage'], 4),
+            'flamegraph': flame.splitlines(),
+        }
+
+    arms = {'asyncio': [], 'native': []}
+    warmup = True
+    frozen = False
+    speed_redos = 0
+    try:
+        while len(arms['native']) < trials:
+            if not warmup and not frozen:
+                gc.collect()
+                gc.freeze()
+                frozen = True
+            # ABBA ordering: alternate which arm goes first each
+            # round. Per-round pairing cancels slow host DRIFT only
+            # if neither arm systematically runs later than the
+            # other; a fixed asyncio-then-native order hands every
+            # within-round slowdown to the native arm.
+            order = ('asyncio', 'native') \
+                if len(arms['native']) % 2 == 0 \
+                else ('native', 'asyncio')
+            round_rates = {}
+            for name in order:
+                rate, clean = await timed_trial(name)
+                if not clean and speed_redos < trials * 2:
+                    speed_redos += 1
+                    round_rates = None
+                    break
+                round_rates[name] = rate
+            if warmup:
+                warmup = False
+                continue
+            if round_rates is None:
+                continue
+            for name, rate in round_rates.items():
+                arms[name].append(rate)
+        receipts = {name: await receipt_trial(name)
+                    for name in arms} if with_receipts else None
+        plane = mod_nt.peek_plane(asyncio.get_running_loop())
+        plane_stats = dict(plane.tx.stats()) if plane is not None \
+            else None
+    finally:
+        try:
+            mod_nt.close_plane(asyncio.get_running_loop())
+        except Exception:
+            pass
+        stop_echo()
+
+    asy_mean = statistics.mean(arms['asyncio'])
+    nat_mean = statistics.mean(arms['native'])
+    return {
+        'ops_per_trial': ops,
+        'concurrency': concurrency,
+        'payload_bytes': payload_bytes,
+        'frames_per_claim': frames_per_claim,
+        'asyncio_ops_per_sec': round(asy_mean, 1),
+        'asyncio_stdev': round(
+            statistics.stdev(arms['asyncio']), 1),
+        'asyncio_trials': [round(r, 1) for r in arms['asyncio']],
+        'native_ops_per_sec': round(nat_mean, 1),
+        'native_stdev': round(statistics.stdev(arms['native']), 1),
+        'native_trials': [round(r, 1) for r in arms['native']],
+        'native_vs_asyncio_x': round(nat_mean / asy_mean, 3),
+        'native_plane_stats': plane_stats,
+        'phase_receipts': receipts,
+        'speed_redos': speed_redos,
+        'protocol': ('%d interleaved trial pairs x %d echo-claim ops '
+                     '(%d frame(s) x %d B per lease, %d outstanding '
+                     'over a %d-slot pool on real loopback, echo '
+                     'served by a separate process), asyncio/native '
+                     'in ABBA order on fresh pools, gc frozen+disabled '
+                     'in timed sections, speed-gated with degraded '
+                     'rounds redone%s') % (
+            trials, ops, frames_per_claim, payload_bytes,
+            concurrency, concurrency,
+            ('; plus one untimed fully-traced %d-op receipt window '
+             'per arm for the phase-ledger decomposition'
+             % NATIVE_AB_RECEIPT_OPS) if with_receipts else ''),
+    }
+
+
+async def bench_native_ab_suite():
+    """Both honest arms of the native A/B. 'bulk' (frames x 8 KiB per
+    lease) is the headline — the regime where buffered writes and
+    C-side read assembly actually run off-loop. 'small' (one 64 B
+    frame per lease) is latency-bound and the native arm PAYS an
+    extra completion hop there; it rides along so the record shows
+    the tradeoff instead of hiding it."""
+    bulk = await bench_native_transport_ab(
+        ops=NATIVE_AB_BULK['ops'],
+        concurrency=NATIVE_AB_BULK['concurrency'],
+        payload_bytes=NATIVE_AB_BULK['payload_bytes'],
+        frames_per_claim=NATIVE_AB_BULK['frames_per_claim'],
+        with_receipts=True)
+    if 'skipped' in bulk:
+        return bulk
+    small = await bench_native_transport_ab(
+        ops=NATIVE_AB_SMALL['ops'],
+        concurrency=NATIVE_AB_SMALL['concurrency'],
+        payload_bytes=NATIVE_AB_SMALL['payload_bytes'],
+        frames_per_claim=NATIVE_AB_SMALL['frames_per_claim'],
+        with_receipts=False)
+    return {'bulk': bulk, 'small': small}
 
 
 # Sharded fleet-router stage: the same saturated-queue protocol as
@@ -2406,7 +2771,8 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
                     health=None, profile_ab=None,
                     profile_attribution=None,
                     profile_flamegraph=None,
-                    claim_many=None, transport_ab=None) -> dict:
+                    claim_many=None, transport_ab=None,
+                    claim_many_sweep=None, native_ab=None) -> dict:
     """Build the single JSON-line result from the stage outputs.
 
     Factored out of main() so the guard tests can assert the
@@ -2541,6 +2907,31 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
         result['claim_many_vs_looped_pct'] = \
             claim_many['batched_vs_looped_pct']
         result['claim_many_ab'] = claim_many
+    if claim_many_sweep is not None:
+        # The 16/64/256 amortization curve; compact per-batch columns
+        # (the full records live under the headline claim_many_ab).
+        result['claim_many_sweep'] = {
+            b: {'looped_ops_per_sec': rec['looped_ops_per_sec'],
+                'batched_ops_per_sec': rec['batched_ops_per_sec'],
+                'batched_vs_looped_pct': rec['batched_vs_looped_pct']}
+            for b, rec in claim_many_sweep.items()}
+    if native_ab is not None:
+        result['claim_native_ab'] = native_ab
+        if 'bulk' in native_ab:
+            # The tentpole headline: the transport-bound bulk-lease
+            # claim rate through the C data plane, next to its
+            # same-host asyncio twin from the interleaved A/B. The
+            # small-frame ratio rides along un-headlined — that
+            # regime is latency-bound and native pays a hop there.
+            bulk = native_ab['bulk']
+            result['claim_release_native_ops_per_sec'] = \
+                bulk['native_ops_per_sec']
+            result['claim_release_native_asyncio_ops_per_sec'] = \
+                bulk['asyncio_ops_per_sec']
+            result['claim_native_vs_asyncio_x'] = \
+                bulk['native_vs_asyncio_x']
+            result['claim_native_small_vs_asyncio_x'] = \
+                native_ab['small']['native_vs_asyncio_x']
     if tracing_ab is not None:
         result['claim_tracing_ab'] = tracing_ab
     if pump_ab is not None:
@@ -2584,7 +2975,8 @@ def assemble_result(abs_err, claim, queued, host_tick, telem,
 async def main(host_only: bool = False, sharded_only: bool = False,
                control_only: bool = False, health_only: bool = False,
                profile_only: bool = False,
-               transport_only: bool = False):
+               transport_only: bool = False,
+               native_only: bool = False):
     """Run the bench and print ONE JSON line.
 
     host_only=True (the `make bench-host` / --host-only path) runs
@@ -2674,6 +3066,24 @@ async def main(host_only: bool = False, sharded_only: bool = False,
         }))
         return
 
+    if native_only:
+        # `make bench-native`: the native-transport data-plane stage
+        # alone — the asyncio-vs-native interleaved A/B on the
+        # transport-bound claim path, with phase-ledger receipts. One
+        # JSON line.
+        native_ab = await bench_native_ab_suite()
+        out = {'native_only': True, 'claim_native_ab': native_ab,
+               'telemetry_code_hash': telemetry_code_hash()}
+        if 'bulk' in native_ab:
+            out['claim_release_native_ops_per_sec'] = \
+                native_ab['bulk']['native_ops_per_sec']
+            out['claim_native_vs_asyncio_x'] = \
+                native_ab['bulk']['native_vs_asyncio_x']
+            out['claim_native_small_vs_asyncio_x'] = \
+                native_ab['small']['native_vs_asyncio_x']
+        print(json.dumps(out))
+        return
+
     if health_only:
         # `make bench-health`: the fleet-health stages alone.
         sweeps = bench_health_sweeps_host()
@@ -2699,7 +3109,9 @@ async def main(host_only: bool = False, sharded_only: bool = False,
     abs_err = await bench_codel_tracking()
     claim = await bench_claim_throughput()
     queued = await bench_queued_claim_throughput()
-    claim_many = await bench_claim_many()
+    claim_many_sweep = await bench_claim_many_sweep()
+    claim_many = claim_many_sweep[str(CLAIM_MANY_BATCH)]
+    native_ab = await bench_native_ab_suite()
     sharded = await bench_sharded_claims_guarded()
     tracing_ab = await bench_tracing_ab()
     pump_ab = await bench_pump_ab()
@@ -2730,7 +3142,9 @@ async def main(host_only: bool = False, sharded_only: bool = False,
                              profile_attribution=profile_attribution,
                              profile_flamegraph=profile_flamegraph,
                              claim_many=claim_many,
-                             transport_ab=transport_ab)
+                             transport_ab=transport_ab,
+                             claim_many_sweep=claim_many_sweep,
+                             native_ab=native_ab)
     # Host-quality canary: when every claim arm runs >10% below the
     # prior committed round, say so IN the round record.
     prior_name, prior = latest_committed_round()
@@ -2751,4 +3165,5 @@ if __name__ == '__main__':
                      health_only='--health-only' in sys.argv[1:],
                      profile_only='--profile-only' in sys.argv[1:],
                      transport_only='--transport-only'
-                                    in sys.argv[1:]))
+                                    in sys.argv[1:],
+                     native_only='--native-only' in sys.argv[1:]))

@@ -49,6 +49,11 @@ _LOG = logging.getLogger('cueball.debug')
 A001_MARSHAL_MODULES = (
     'debug.py',
     'integrations/httpx.py',
+    # Native-plane teardown crosses threads (shard router joining a
+    # worker loop): the completion-pump reader must be removed on the
+    # owning loop, so close_plane_threadsafe marshals the close with
+    # call_soon_threadsafe.
+    'native_transport.py',
     'shard/proc.py',
     'shard/router.py',
     'shard/worker.py',

@@ -1,0 +1,625 @@
+"""Python control plane for the native C transport data plane.
+
+``native/transport.c`` owns an epoll/io_uring readiness loop on its
+own thread and moves connect/read/write/DNS bytes without touching
+the Python event loop; completions surface in batches through a
+preallocated SPSC ring. This module is the thin dispatcher on top:
+
+- :class:`NativePlane` — one per asyncio loop. Registers the C
+  loop's completion eventfd with ``loop.add_reader`` so the whole
+  batch drains in ONE pump crossing per loop tick, then fans each
+  completion out to the owning connection/operation.
+- :class:`NativeConnection` — the connection-contract twin of
+  ``transport.TcpStreamConnection`` (emits 'connect'/'error'/'close',
+  destroy/ref/unref, wiretap wire marks) whose bytes never cross the
+  Python loop until a consumer asks for them.
+- :class:`RealNativeTransport` — the five-seam ``Transport``
+  implementation registered over the ``'native'`` stub when the
+  extension exports the transport symbols. connector/dns_udp/dns_tcp
+  ride the C plane; serve/create_stream fall back to asyncio plumbing
+  (documented in docs/transport.md — the pool claim path and the DNS
+  wire are the hot paths this PR moves off-loop) while still
+  accounting to the 'native' ledger rows.
+
+Wire accounting: the C side counts seam events into per-seam atomic
+counters (same field order as ``wiretap.SeamStats.__slots__``); the
+plane folds counter deltas into the live ``TransportLedger`` at every
+drain and via a registered wiretap pull source, so ``snapshot()`` /
+``wire_totals()`` see up-to-date native rows without a Python-side
+callback per byte.
+
+Determinism: a plane refuses to exist under a non-system clock
+(netsim's virtual time cannot drive a kernel poller), mirroring
+``profile.start_sampler``. The fabric transport stays the
+deterministic arm; the parity suite pins the two against each other.
+
+This module is C110-licensed (tools/cblint.py) to touch sockets: it
+IS the byte-moving seam when the native backend is selected.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import errno as mod_errno
+import os
+import socket as mod_socket
+import threading
+
+from . import runq as mod_runq
+from . import utils as mod_utils
+from . import wiretap as mod_wiretap
+from .errors import TransportNotAvailableError
+from .events import EventEmitter
+from .transport import Transport
+
+_native = None
+if not os.environ.get('CUEBALL_NO_NATIVE'):
+    try:
+        from . import _cueball_native as _native_mod
+    except ImportError:
+        _native_mod = None
+    # A stale .so built before the transport unit landed has the
+    # emitter surface but no txloop_new: treat it as absent rather
+    # than blowing up at first use.
+    if _native_mod is not None and hasattr(_native_mod, 'txloop_new'):
+        _native = _native_mod
+
+#: Profiler seam (cbflow A005): profile._bind_seams points this at the
+#: live sampler so drain crossings attribute to their phase.
+_prof = None
+
+#: Completion-ring drain batch per pump crossing; matches the C-side
+#: default ring capacity.
+DRAIN_BATCH = 1024
+
+_planes: dict = {}            # asyncio loop -> NativePlane
+_planes_lock = threading.Lock()
+
+
+def native_available() -> bool:
+    """True when the extension is importable and exports the
+    transport data-plane symbols (txloop_new/transport_probe)."""
+    return _native is not None
+
+
+def transport_probe() -> dict:
+    """Build/runtime feature matrix: {'epoll': bool,
+    'io_uring_built': bool, 'io_uring_runtime': bool}."""
+    if _native is None:
+        return {'epoll': False, 'io_uring_built': False,
+                'io_uring_runtime': False}
+    return _native.transport_probe()
+
+
+def _oserror(status: int) -> OSError:
+    """Map a negative-errno completion status to the OSError subclass
+    asyncio would raise for the same failure (OSError.__new__ picks
+    ConnectionRefusedError etc. from the errno)."""
+    e = -status if status < 0 else status
+    return OSError(e, os.strerror(e))
+
+
+class NativePlane:
+    """One C transport loop bound to one asyncio loop: owns the
+    TransportLoop object, the completion-drain pump, and the id ->
+    connection/operation dispatch tables."""
+
+    def __init__(self, loop, backend: str = 'auto',
+                 ring_cap: int = 1024):
+        self.loop = loop
+        self.tx = _native.txloop_new(ring_cap=ring_cap,
+                                     backend=backend)
+        self.conns: dict = {}     # conn_id -> NativeConnection
+        self.ops: dict = {}       # op_id -> Future | callable
+        self.closed = False
+        self.drains = 0
+        # Per-seam counter baseline for ledger folding: deltas since
+        # the last fold are added to the live SeamStats, so enabling
+        # wiretap mid-flight starts counting from that moment (same
+        # semantics as the asyncio arm).
+        self._folded: dict = {}
+        self._fold_baseline()
+        loop.add_reader(self.tx.fileno(), self._on_wake)
+
+    # -- completion pump -------------------------------------------------
+
+    def _on_wake(self) -> None:
+        self.drain()
+
+    def drain(self) -> int:
+        """The one pump crossing per tick: pull the completion batch
+        out of the SPSC ring and dispatch every entry."""
+        if self.closed:
+            return 0
+        prof = _prof
+        tok = prof.push_phase('runq_pump') if prof is not None else None
+        try:
+            batch = self.tx.drain(DRAIN_BATCH)
+            for kind, cid, status, t_ready, payload in batch:
+                self._dispatch(kind, cid, status, t_ready, payload)
+        finally:
+            if tok is not None:
+                prof.pop_phase(tok)
+        self.drains += 1
+        self._fold_counters()
+        return len(batch)
+
+    def _dispatch(self, kind, cid, status, t_ready, payload) -> None:
+        tx = _native
+        if kind == tx.TX_CONNECT:
+            conn = self.conns.get(cid)
+            if conn is None or conn.destroyed:
+                return
+            if status == 0:
+                # (kernel-ready, dispatched): t_ready was stamped by
+                # the C thread the instant SO_ERROR cleared; the
+                # second mark is now, after the pump crossing — the
+                # wiretap socket_wait decomposition reads the gap as
+                # loop_dispatch.
+                conn.wt_marks = (t_ready, mod_utils.current_millis())
+                conn.emit('connect')
+            else:
+                self.conns.pop(cid, None)
+                conn.emit('error', _oserror(status))
+        elif kind in (tx.TX_READ, tx.TX_DNS_UDP, tx.TX_DNS_TCP):
+            fut = self.ops.pop(cid, None)
+            if fut is None or fut.done():
+                return
+            if status == 0:
+                fut.set_result(payload if payload is not None else b'')
+            elif status == -mod_errno.ETIMEDOUT:
+                fut.set_exception(asyncio.TimeoutError())
+            else:
+                fut.set_exception(_oserror(status))
+        elif kind == tx.TX_DATA:
+            conn = self.conns.get(cid)
+            if conn is None or conn.destroyed:
+                return
+            # Push-vs-pull is decided by listener presence: only drain
+            # the C receive buffer into a 'data' emit when someone is
+            # subscribed. A pull-mode conn (read_exactly) must find the
+            # bytes still in the C buffer — eagerly consuming here
+            # loses the race where the peer's response lands before
+            # the reader parks its op, stranding the read forever.
+            if not conn.listeners('data'):
+                return
+            data = self.tx.read_available(cid)
+            if data:
+                conn.emit('data', data)
+        elif kind == tx.TX_CLOSE:
+            conn = self.conns.pop(cid, None)
+            if conn is None or conn.destroyed:
+                return
+            conn.emit('close')
+        elif kind == tx.TX_ERROR:
+            conn = self.conns.pop(cid, None)
+            if conn is None or conn.destroyed:
+                return
+            conn.emit('error', _oserror(status))
+        elif kind == tx.TX_TIMER:
+            cb = self.ops.pop(cid, None)
+            if cb is not None and not self.closed:
+                cb()
+
+    # -- wire-ledger folding ---------------------------------------------
+
+    def _fold_baseline(self) -> None:
+        self._folded = {seam: dict(fields) for seam, fields
+                        in self.tx.counters().items()}
+
+    def _fold_counters(self) -> None:
+        """Add C-side counter deltas to the live TransportLedger's
+        'native' SeamStats rows. When wiretap is off the baseline
+        still advances, so pre-enable traffic is never retro-counted
+        (matching the asyncio arm, which simply doesn't count while
+        disabled)."""
+        cur = self.tx.counters()
+        folded = self._folded
+        enabled = mod_wiretap.wiretap_enabled()
+        for seam, fields in cur.items():
+            last = folded.get(seam, {})
+            if enabled:
+                deltas = [(field, value - last.get(field, 0))
+                          for field, value in fields.items()]
+                # Only materialize a ledger row once the seam has
+                # actually moved (snapshot() reports touched seams;
+                # an all-zero native dns row would break set parity
+                # with the asyncio arm).
+                if any(d for _f, d in deltas):
+                    st = mod_wiretap.seam_stats('native', seam)
+                    if st is not None:
+                        for field, delta in deltas:
+                            if delta:
+                                setattr(st, field,
+                                        getattr(st, field) + delta)
+            folded[seam] = fields
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self.loop.remove_reader(self.tx.fileno())
+        except Exception:
+            pass                  # loop already closed
+        for op in list(self.ops.values()):
+            if isinstance(op, asyncio.Future) and not op.done():
+                op.cancel()
+        self.ops.clear()
+        for conn in list(self.conns.values()):
+            conn.destroyed = True
+        self.conns.clear()
+        self.tx.shutdown()
+
+    def stats(self) -> dict:
+        return self.tx.stats()
+
+
+def get_plane(loop=None, backend: str | None = None) -> NativePlane:
+    """The NativePlane for ``loop`` (default: the running loop),
+    created on first use. Refuses when the extension lacks transport
+    symbols or a non-system clock is installed (netsim virtual time
+    cannot drive a kernel poller — same refusal profile.start_sampler
+    makes)."""
+    if _native is None:
+        raise TransportNotAvailableError('resolve', transport='native')
+    if not isinstance(mod_utils.get_clock(), mod_utils.SystemClock):
+        raise TransportNotAvailableError(
+            'resolve', transport='native',
+            cause=RuntimeError('non-system clock installed (netsim?)'))
+    if loop is None:
+        loop = asyncio.get_running_loop()
+    with _planes_lock:
+        plane = _planes.get(loop)
+        if plane is not None and not plane.closed:
+            return plane
+        # Prune planes whose loops are gone before adding a new one.
+        for stale_loop in [l for l, p in _planes.items()
+                           if p.closed or l.is_closed()]:
+            stale = _planes.pop(stale_loop)
+            if not stale.closed:
+                stale.close()
+        plane = NativePlane(
+            loop, backend=backend
+            or os.environ.get('CUEBALL_NATIVE_POLLER', 'auto'))
+        _planes[loop] = plane
+    return plane
+
+
+def peek_plane(loop) -> NativePlane | None:
+    """The existing (open) plane for ``loop``, or None — never
+    creates one. The runq wheel hook uses this so timers only ride
+    the C plane on loops that already run native transport."""
+    with _planes_lock:
+        plane = _planes.get(loop)
+    if plane is None or plane.closed:
+        return None
+    return plane
+
+
+def close_plane(loop) -> bool:
+    """Tear down the plane bound to ``loop`` from the loop's own
+    thread. Returns True when a live plane was closed."""
+    with _planes_lock:
+        plane = _planes.pop(loop, None)
+    if plane is None or plane.closed:
+        return False
+    plane.close()
+    return True
+
+
+def close_plane_threadsafe(loop) -> bool:
+    """Request teardown of any plane bound to ``loop`` from ANY
+    thread (shard teardown reaches worker loops from the router
+    thread). Both the lookup and the close must run on the owning
+    loop — a foreign-thread lookup would race plane creation, and
+    ``remove_reader`` is not thread-safe — so the whole operation is
+    marshalled across with ``call_soon_threadsafe`` (the
+    A001-licensed crossing for this module). Returns True when the
+    close was dispatched (or, for a dead loop, performed inline)."""
+    if not loop.is_closed():
+        try:
+            loop.call_soon_threadsafe(_close_on_loop, loop)
+            return True
+        except RuntimeError:
+            pass                  # lost the race with loop.close()
+    # Dead loop: nothing pumps add_reader anymore, close inline.
+    with _planes_lock:
+        plane = _planes.pop(loop, None)
+    if plane is None or plane.closed:
+        return False
+    plane.close()
+    return True
+
+
+def _close_on_loop(loop) -> None:
+    close_plane(loop)
+
+
+@atexit.register
+def _close_all_planes() -> None:
+    with _planes_lock:
+        planes = list(_planes.values())
+        _planes.clear()
+    for plane in planes:
+        try:
+            plane.close()
+        except Exception:
+            pass
+
+
+# -- runq claim-deadline timers on the C plane ------------------------------
+
+def _native_wheel_timer(loop, delay_ms: float, fire) -> bool:
+    """runq.set_native_timer hook: arm a timer-wheel bucket deadline
+    on the C plane's deadline heap instead of ``loop.call_later``.
+    Returns False (caller falls back to call_later) when the loop has
+    no live plane — netsim loops and plain asyncio pools keep their
+    exact current behavior."""
+    plane = peek_plane(loop)
+    if plane is None:
+        return False
+    try:
+        op_id = plane.tx.timer(max(delay_ms, 0.0))
+    except RuntimeError:
+        return False              # plane shutting down mid-arm
+    plane.ops[op_id] = fire
+    return True
+
+
+# -- connection contract ----------------------------------------------------
+
+class NativeConnection(EventEmitter):
+    """Connection-contract object over the C data plane: the native
+    twin of ``transport.TcpStreamConnection`` / netsim's
+    SimConnection. Emits 'connect' once the C thread reports the
+    socket writable, 'error'/'close' on loss, 'data' when coalesced
+    bytes arrive. Seam accounting (events/connects/errors/closes and
+    byte counts) happens entirely C-side and reaches the wiretap
+    ledger via the plane's counter fold."""
+
+    def __init__(self, transport, backend: dict, plane: NativePlane):
+        super().__init__()
+        self.transport = transport
+        self.backend = backend
+        self.destroyed = False
+        self.wt_marks = None
+        self.wt_transport = transport.name
+        self._plane = plane
+        self.conn_id = None
+        host = str(backend['address'])
+        port = int(backend['port'])
+        try:
+            cid = plane.tx.connect(host, port, 0.0)
+        except ValueError:
+            # Non-numeric host: resolve here (one-time, submit path,
+            # not per-byte) and hand the C plane a literal.
+            try:
+                infos = mod_socket.getaddrinfo(
+                    host, port, type=mod_socket.SOCK_STREAM)
+                cid = plane.tx.connect(infos[0][4][0], port, 0.0)
+            except OSError as e:
+                # Contract: connect failures surface as an 'error'
+                # emit after the constructor returns (the FSM attaches
+                # listeners first), never as a constructor raise.
+                plane.loop.call_soon(self._emit_error, e)
+                return
+        self.conn_id = cid
+        plane.conns[cid] = self
+
+    def _emit_error(self, exc) -> None:
+        if not self.destroyed:
+            self.emit('error', exc)
+
+    def write(self, data: bytes) -> int:
+        """Submit bytes; small writes to an open, unblocked socket go
+        inline (one syscall, zero crossings), larger or blocked ones
+        are buffered and flushed by the C thread."""
+        if self.destroyed or self.conn_id is None:
+            return 0
+        return self._plane.tx.write(self.conn_id, data)
+
+    async def read_exactly(self, n: int,
+                           timeout_ms: float = 0.0) -> bytes:
+        """Exactly-n read: satisfied from the C-side receive buffer
+        with zero crossings when the bytes already landed, else
+        parked on the plane until the C thread completes it."""
+        if self.destroyed or self.conn_id is None:
+            raise _oserror(mod_errno.ENOTCONN)
+        got = self._plane.tx.read(self.conn_id, n, timeout_ms)
+        if isinstance(got, bytes):
+            return got
+        fut = self._plane.loop.create_future()
+        self._plane.ops[got] = fut
+        return await fut
+
+    def read_available(self) -> bytes:
+        if self.destroyed or self.conn_id is None:
+            return b''
+        return self._plane.tx.read_available(self.conn_id)
+
+    def on(self, event, listener):
+        out = super().on(event, listener)
+        # Late push-mode subscriber: bytes that landed before the
+        # first 'data' listener attached are still sitting in the C
+        # buffer (the pump leaves them for pull-mode readers). Flush
+        # them to the new listener asynchronously so attach order
+        # doesn't lose data.
+        if event == 'data' and not self.destroyed \
+                and self.conn_id is not None:
+            def catch_up():
+                if self.destroyed or self.conn_id is None:
+                    return
+                data = self._plane.tx.read_available(self.conn_id)
+                if data:
+                    self.emit('data', data)
+            self._plane.loop.call_soon(catch_up)
+        return out
+
+    def destroy(self) -> None:
+        if self.destroyed:
+            return
+        self.destroyed = True
+        if self.conn_id is not None:
+            self._plane.conns.pop(self.conn_id, None)
+            if not self._plane.closed:
+                try:
+                    self._plane.tx.close_conn(self.conn_id)
+                except RuntimeError:
+                    pass          # plane shut down under us
+
+    def ref(self):
+        pass
+
+    def unref(self):
+        pass
+
+
+# -- the five-seam transport ------------------------------------------------
+
+class RealNativeTransport(Transport):
+    """The native backend behind the ``Transport`` seam contract.
+    connector / dns_udp / dns_tcp run on the C data plane;
+    create_stream / serve are asyncio-backed fallbacks accounted to
+    the 'native' ledger rows (the HTTP agent and kang endpoint are
+    not claim-path-hot; see docs/transport.md §Native backend)."""
+
+    name = 'native'
+
+    @property
+    def available(self) -> bool:
+        return native_available()
+
+    def __init__(self, backend: str | None = None):
+        self._poller = backend
+
+    def _plane(self, loop=None) -> NativePlane:
+        return get_plane(loop, backend=self._poller)
+
+    # -- pool constructor seam -------------------------------------------
+
+    def connector(self, backend: dict) -> NativeConnection:
+        plane = self._plane()
+        return NativeConnection(self, backend, plane)
+
+    # -- stream seam (asyncio fallback, native-accounted) ----------------
+
+    async def create_stream(self, protocol_factory, host, port,
+                            ssl=None, server_hostname=None):
+        st = mod_wiretap.seam_stats(self.name, 'create_stream')
+        if st is not None:
+            st.events += 1
+        try:
+            result = await self._open_stream(
+                protocol_factory, host, port, ssl=ssl,
+                server_hostname=server_hostname)
+        except OSError:
+            if st is not None:
+                st.errors += 1
+            raise
+        if st is not None:
+            st.connects += 1
+        return result
+
+    async def _open_stream(self, protocol_factory, host, port,
+                           ssl=None, server_hostname=None):
+        loop = asyncio.get_running_loop()
+        kwargs = {}
+        if ssl is not None:
+            kwargs['ssl'] = ssl
+            kwargs['server_hostname'] = server_hostname
+        return await loop.create_connection(
+            protocol_factory, host, port, **kwargs)
+
+    def configure_keepalive(self, stream_transport,
+                            delay_ms: float | None = None) -> int | None:
+        sock = stream_transport.get_extra_info('socket')
+        if sock is None:
+            return None
+        sock.setsockopt(mod_socket.SOL_SOCKET,
+                        mod_socket.SO_KEEPALIVE, 1)
+        if delay_ms is not None and hasattr(mod_socket,
+                                            'TCP_KEEPIDLE'):
+            sock.setsockopt(mod_socket.IPPROTO_TCP,
+                            mod_socket.TCP_KEEPIDLE,
+                            max(1, int(delay_ms / 1000)))
+        return sock.getsockname()[1]
+
+    # -- server seam (asyncio fallback, native-accounted) ----------------
+
+    async def serve(self, client_connected_cb, host, port):
+        st = mod_wiretap.seam_stats(self.name, 'serve')
+        if st is not None:
+            st.events += 1
+            inner_cb = client_connected_cb
+
+            def client_connected_cb(reader, writer):
+                st.connects += 1
+                return inner_cb(reader, writer)
+
+        return await asyncio.start_server(
+            client_connected_cb, host, port)
+
+    # -- DNS wire seam (C plane) -----------------------------------------
+
+    async def dns_udp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        return await self._dns(False, resolver, port, payload,
+                               timeout_s)
+
+    async def dns_tcp(self, resolver: str, port: int, payload: bytes,
+                      timeout_s: float) -> bytes:
+        return await self._dns(True, resolver, port, payload,
+                               timeout_s)
+
+    async def _dns(self, tcp: bool, resolver: str, port: int,
+                   payload: bytes, timeout_s: float) -> bytes:
+        plane = self._plane()
+        submit = plane.tx.dns_tcp if tcp else plane.tx.dns_udp
+        host = str(resolver)
+        timeout_ms = max(float(timeout_s), 0.0) * 1000.0
+        try:
+            op_id = submit(host, int(port), payload, timeout_ms)
+        except ValueError:
+            # Non-numeric resolver name: resolve without blocking the
+            # loop, then hand the C plane a literal.
+            socktype = (mod_socket.SOCK_STREAM if tcp
+                        else mod_socket.SOCK_DGRAM)
+            infos = await plane.loop.getaddrinfo(host, int(port),
+                                                 type=socktype)
+            op_id = submit(infos[0][4][0], int(port), payload,
+                           timeout_ms)
+        fut = plane.loop.create_future()
+        plane.ops[op_id] = fut
+        return await fut
+
+    # -- identity --------------------------------------------------------
+
+    def host_ident(self) -> str:
+        return mod_socket.gethostname()
+
+
+# -- wiretap pull source ----------------------------------------------------
+
+def _pull_wire_counters() -> None:
+    """wiretap wire-source hook: fold every live plane's counters so
+    snapshot()/wire_totals() read current native rows even between
+    drains."""
+    with _planes_lock:
+        planes = list(_planes.values())
+    for plane in planes:
+        if not plane.closed:
+            plane._fold_counters()
+
+
+mod_wiretap.register_wire_source(_pull_wire_counters)
+mod_runq.set_native_timer(_native_wheel_timer)
+
+
+__all__ = ['NativePlane', 'NativeConnection', 'RealNativeTransport',
+           'native_available', 'transport_probe', 'get_plane',
+           'peek_plane', 'close_plane', 'close_plane_threadsafe',
+           'DRAIN_BATCH']

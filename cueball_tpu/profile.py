@@ -338,7 +338,8 @@ _pure_hint = _PHASE_IDS['other']
 # Modules that carry a `_prof` seam; bound lazily at sampler start so
 # importing profile never drags the whole engine in.
 _SEAM_MODULES = ('cueball_tpu.pool', 'cueball_tpu.connection_fsm',
-                 'cueball_tpu.runq', 'cueball_tpu.fsm')
+                 'cueball_tpu.runq', 'cueball_tpu.fsm',
+                 'cueball_tpu.native_transport')
 
 
 def push_phase(name: str) -> int:

@@ -162,6 +162,36 @@ WHEEL_QUANTUM_MS = 5.0
 _wheel: dict = {}  # loop -> {bucket: {token: handle}}
 _wheel_tok = 0
 
+#: Optional native bucket-timer hook: ``fn(loop, delay_ms, fire) ->
+#: bool`` arms the bucket deadline on the C transport plane's deadline
+#: heap (one TIMER completion in the batched drain instead of an
+#: asyncio TimerHandle per bucket). A False return — no plane bound to
+#: this loop, or it is shutting down — falls back to loop.call_later,
+#: so netsim/virtual-clock loops and plain asyncio pools are
+#: untouched. Installed by cueball_tpu.native_transport on import.
+_native_timer = None
+
+
+def set_native_timer(fn) -> None:
+    """Install (or clear, with None) the native bucket-timer hook."""
+    global _native_timer
+    _native_timer = fn
+
+
+def _arm_bucket(loop, bucket) -> None:
+    """Arm the single shared timer for a fresh wheel bucket, on the
+    native plane's deadline heap when one is bound to this loop, else
+    via loop.call_later."""
+    delay_ms = max(
+        bucket * WHEEL_QUANTUM_MS - mod_utils.current_millis(), 0.0)
+    hook = _native_timer
+    if hook is not None:
+        def fire(loop=loop, bucket=bucket):
+            _wheel_fire(loop, bucket)
+        if hook(loop, delay_ms, fire):
+            return
+    loop.call_later(delay_ms / 1000.0, _wheel_fire, loop, bucket)
+
 
 def wheel_arm(deadline_ms, handle):
     """Park `handle` until monotonic-ms `deadline_ms` rounds up to its
@@ -184,9 +214,7 @@ def wheel_arm(deadline_ms, handle):
     slot = buckets.get(bucket)
     if slot is None:
         slot = buckets[bucket] = {}
-        delay_ms = bucket * WHEEL_QUANTUM_MS - mod_utils.current_millis()
-        loop.call_later(max(delay_ms, 0.0) / 1000.0,
-                        _wheel_fire, loop, bucket)
+        _arm_bucket(loop, bucket)
     slot[token] = handle
     return token
 
@@ -209,9 +237,7 @@ def wheel_arm_many(deadline_ms, handles):
     slot = buckets.get(bucket)
     if slot is None:
         slot = buckets[bucket] = {}
-        delay_ms = bucket * WHEEL_QUANTUM_MS - mod_utils.current_millis()
-        loop.call_later(max(delay_ms, 0.0) / 1000.0,
-                        _wheel_fire, loop, bucket)
+        _arm_bucket(loop, bucket)
     tokens = []
     for handle in handles:
         _wheel_tok += 1

@@ -28,6 +28,7 @@ from __future__ import annotations
 import asyncio
 import importlib
 import os
+import sys
 import threading
 
 from ..errors import ShardDeadError
@@ -232,6 +233,16 @@ class ThreadWorker(ShardWorker):
                 pass
         finally:
             self._loop_exited = True
+            # A native transport plane bound to this loop holds a C
+            # poller thread and an add_reader registration; tear it
+            # down before the loop object dies (only when the module
+            # was ever loaded — don't drag the extension in here).
+            nt = sys.modules.get('cueball_tpu.native_transport')
+            if nt is not None:
+                try:
+                    nt.close_plane(loop)
+                except Exception:
+                    pass
             try:
                 loop.close()
             except RuntimeError:

@@ -529,6 +529,18 @@ def get_transport(spec=None) -> Transport:
             _default = AsyncioTransport()
         return _default
     if isinstance(spec, str):
+        if spec == 'native' and _REGISTRY.get('native') is NativeTransport:
+            # Upgrade the stub to the real C data plane lazily, the
+            # first time anyone asks for it: native_transport imports
+            # the extension and registers itself when the transport
+            # symbols are present; otherwise the stub's typed
+            # resolution refusal below stands.
+            try:
+                from . import native_transport as _nt
+            except ImportError:
+                _nt = None
+            if _nt is not None and _nt.native_available():
+                register_transport('native', _nt.RealNativeTransport)
         factory = _REGISTRY.get(spec)
         if factory is None:
             raise ValueError('unknown transport %r (registered: %s)' % (

@@ -60,6 +60,8 @@ __all__ = [
     'connect_breakdown',
     'snapshot',
     'wire_totals',
+    'register_wire_source',
+    'unregister_wire_source',
     'start_loop_lag_sampler',
     'stop_loop_lag_sampler',
     'loop_lag_stats',
@@ -363,14 +365,48 @@ def connect_breakdown(start: float, end: float):
     return led.connect_breakdown(start, end)
 
 
+#: Pull hooks for backends that count wire traffic out-of-band (the
+#: native C data plane folds its atomic counters into the ledger on
+#: demand). Called before every module-level snapshot/wire_totals
+#: read so readers never see stale native rows.
+_WIRE_SOURCES: list = []
+
+
+def register_wire_source(pull) -> None:
+    """Register a zero-arg callable that folds externally-counted
+    wire traffic into the live ledger; invoked before snapshot() and
+    wire_totals(). Idempotent per callable."""
+    if pull not in _WIRE_SOURCES:
+        _WIRE_SOURCES.append(pull)
+
+
+def unregister_wire_source(pull) -> bool:
+    try:
+        _WIRE_SOURCES.remove(pull)
+        return True
+    except ValueError:
+        return False
+
+
+def _pull_wire_sources() -> None:
+    for pull in list(_WIRE_SOURCES):
+        pull()
+
+
 def snapshot() -> dict:
     led = _LEDGER
-    return led.snapshot() if led is not None else {}
+    if led is None:
+        return {}
+    _pull_wire_sources()
+    return led.snapshot()
 
 
 def wire_totals() -> dict:
     led = _LEDGER
-    return led.wire_totals() if led is not None else {}
+    if led is None:
+        return {}
+    _pull_wire_sources()
+    return led.wire_totals()
 
 
 # -- loop-lag sampler --------------------------------------------------------

@@ -25,6 +25,26 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def probe_io_uring():
+    """Build-time feature probe for the transport.c io_uring poller.
+
+    The data plane only needs POLL_ADD readiness mode plus the NODROP
+    completion guarantee; both are declared in <linux/io_uring.h>.
+    Runtime availability (seccomp, old kernel) is probed separately by
+    ``_cueball_native.transport_probe()`` — a header hit here only
+    compiles the code path in, with epoll as the runtime fallback.
+    """
+    hdr = '/usr/include/linux/io_uring.h'
+    try:
+        with open(hdr, 'r', encoding='utf-8', errors='replace') as f:
+            text = f.read()
+    except OSError:
+        return False
+    return ('IORING_OP_POLL_ADD' in text
+            and 'IORING_FEAT_NODROP' in text
+            and 'IORING_SETUP_CQSIZE' in text)
+
+
 def main():
     os.chdir(ROOT)
     from setuptools import Extension, setup
@@ -37,6 +57,9 @@ def main():
     else:
         cflags = ['-O2']
         ldflags = []
+    define_macros = []
+    if probe_io_uring():
+        define_macros.append(('CUEBALL_HAVE_IO_URING', '1'))
     script_args = ['build_ext', '--inplace']
     if sanitize or force:
         # Flags changed relative to whatever .o is cached: rebuild.
@@ -46,7 +69,9 @@ def main():
         name='cueball-tpu-native',
         ext_modules=[Extension(
             'cueball_tpu._cueball_native',
-            sources=['native/emitter.c'],
+            sources=['native/emitter.c', 'native/transport.c'],
+            depends=['native/transport.h'],
+            define_macros=define_macros,
             extra_compile_args=cflags,
             extra_link_args=ldflags,
         )],

@@ -33,6 +33,8 @@
 #include <signal.h>
 #include <sys/time.h>
 
+#include "transport.h"
+
 /* Python < 3.12 compatibility: the single-object exception API this
    file uses landed in 3.12. Express it via the legacy Fetch/Restore
    triple on older runtimes — without this the extension compiles (the
@@ -2493,6 +2495,16 @@ trace_emit(uint64_t serial, uint32_t code, uint32_t flags,
         trace_highwater = (Py_ssize_t)(trace_head - trace_tail);
 }
 
+/* Exported for transport.c: stamp one reserved wire-event slot
+   (trace.WIRE_EVENT_CODES, 14..18) into the span ring.  serial 0 and
+   no object — trace._drain_native skips these codes, so the NDJSON
+   stream is unchanged; wiretap's ring scanners see them in place. */
+void
+cueball_wire_trace_emit(uint32_t code, double t, double a, double b)
+{
+    trace_emit(0, code, 0, t, a, b, NULL);
+}
+
 static PyObject *
 trace_ring_configure(PyObject *mod, PyObject *arg)
 {
@@ -3570,6 +3582,10 @@ PyInit__cueball_native(void)
     if (PyModule_AddObject(m, "NativeTrace",
                            (PyObject *)&NTrace_Type) < 0) {
         Py_DECREF(&NTrace_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    if (cueball_transport_init(m) < 0) {
         Py_DECREF(m);
         return NULL;
     }

@@ -1,0 +1,2584 @@
+/*
+ * transport.c — the native transport data plane.
+ *
+ * One TransportLoop per event loop (shard): a dedicated C thread owns
+ * an epoll (or io_uring POLL_ADD, when built and runtime-probed)
+ * readiness loop and moves connect/read/write/DNS-UDP/DNS-TCP bytes
+ * without ever touching the Python event loop — or the GIL — on the
+ * hot path.  Completions are published into a preallocated SPSC ring
+ * (C producer, Python-under-GIL consumer) and the Python side is
+ * woken through an eventfd at the empty->nonempty edge only, so one
+ * drain crossing per tick services an arbitrary batch.
+ *
+ * Locking: `mu` protects the submission list, the conn table, and
+ * each conn's buffers/state.  The C thread never takes the GIL; the
+ * Python-facing methods never block while holding `mu`.  The
+ * completion ring is lock-free SPSC (C11 acquire/release).
+ *
+ * Write specialization: small writes (<= CB_INLINE_WRITE_MAX) on an
+ * idle open socket are sent inline from the submitting thread (one
+ * nonblocking send under `mu`, zero thread crossings); anything
+ * larger, queued behind earlier bytes, or short-written falls back to
+ * the buffered path flushed by the C thread on POLLOUT.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/prctl.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#ifdef CUEBALL_HAVE_IO_URING
+#include <linux/io_uring.h>
+#include <linux/time_types.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#endif
+
+#include "transport.h"
+
+/* Completion kinds (mirrored into the module dict as TX_*). */
+#define CB_COMP_CONNECT 1   /* id=conn_id, t_ready=kernel-ready ms   */
+#define CB_COMP_READ    2   /* id=op_id, payload=exactly-n bytes     */
+#define CB_COMP_DATA    3   /* id=conn_id, unsolicited bytes waiting */
+#define CB_COMP_CLOSE   4   /* id=conn_id, orderly EOF / destroy     */
+#define CB_COMP_ERROR   5   /* id=conn_id, status=-errno             */
+#define CB_COMP_DNS_UDP 6   /* id=op_id, payload=response datagram   */
+#define CB_COMP_DNS_TCP 7   /* id=op_id, payload=deframed response   */
+#define CB_COMP_TIMER   8   /* id=op_id                              */
+
+/* trace.WIRE_EVENT_CODES — reserved slot codes stamped at submit. */
+#define CB_WEV_CONNECTOR 14
+#define CB_WEV_DNS_UDP   17
+#define CB_WEV_DNS_TCP   18
+
+#define CB_INLINE_WRITE_MAX 4096
+#define CB_RBUF_MAX         (1 << 20)
+#define CB_READ_CHUNK       16384
+#define CB_CONN_BUCKETS     256
+#define CB_MAX_POLL_EVENTS  64
+
+/* Seam/field indices for the per-seam wire counters; field order is
+   exactly wiretap.SeamStats.__slots__[:8]. */
+enum { SEAM_CONN = 0, SEAM_UDP = 1, SEAM_TCP = 2, SEAM_N = 3 };
+enum { WF_EVENTS = 0, WF_CONNECTS, WF_ERRORS, WF_CLOSES, WF_READS,
+       WF_WRITES, WF_BYTES_IN, WF_BYTES_OUT, WF_N };
+
+/* Op kinds. */
+enum { OP_CONNECT = 1, OP_READ, OP_DNS_UDP, OP_DNS_TCP, OP_TIMER };
+
+/* Conn states. */
+enum { CS_CONNECTING = 0, CS_OPEN, CS_CLOSED };
+
+/* DNS state machine states. */
+enum { DS_UDP_SEND = 1, DS_UDP_WAIT, DS_TCP_CONNECTING, DS_TCP_WRITE,
+       DS_TCP_READ };
+
+/* Submission kinds. */
+enum { SM_CONNECT = 1, SM_READ, SM_WANT_WRITE, SM_WANT_READ, SM_CLOSE,
+       SM_RELEASE, SM_DNS, SM_TIMER, SM_STOP };
+
+/* Registration kinds. */
+enum { RK_SUB = 1, RK_CONN, RK_DNS };
+
+typedef struct ByteBuf {
+    char *p;
+    size_t cap;
+    size_t len;   /* end of valid bytes                 */
+    size_t off;   /* consumed prefix (valid = off..len) */
+} ByteBuf;
+
+typedef struct Reg {
+    int fd;
+    uint32_t events;   /* desired poll mask; 0 = unregistered */
+    uint32_t gen;
+    uint32_t idx;
+    int kind;
+    int in_use;
+    int armed;         /* io_uring: POLL_ADD outstanding */
+    void *obj;
+} Reg;
+
+struct TxOp;
+
+typedef struct TxConn {
+    uint64_t id;
+    int fd;
+    int state;
+    int data_posted;   /* DATA completion outstanding        */
+    int rd_paused;     /* POLLIN dropped: rbuf at high-water */
+    int close_posted;
+    Reg *reg;
+    ByteBuf rbuf;
+    ByteBuf wbuf;
+    struct TxOp *pending_read;
+    struct TxOp *connect_op;
+    struct TxConn *next;
+} TxConn;
+
+typedef struct TxOp {
+    uint64_t id;
+    int kind;
+    TxConn *conn;               /* OP_CONNECT / OP_READ            */
+    int fd;                     /* DNS ops own their fd            */
+    Reg *reg;
+    int dns_state;
+    uint16_t qid;
+    struct sockaddr_storage addr;
+    socklen_t addrlen;
+    ByteBuf out;
+    ByteBuf in;
+    size_t want;                /* read-exactly n / TCP body len   */
+    double deadline;            /* monotonic ms; 0 = none          */
+    int heap_idx;               /* -1 = not in the deadline heap   */
+    int sm_pending;             /* SM_READ msg not yet consumed    */
+    int done_early;             /* completed while sm_pending: the
+                                   free is deferred to sm_read()   */
+} TxOp;
+
+typedef struct SubMsg {
+    int kind;
+    void *obj;
+    struct SubMsg *next;
+} SubMsg;
+
+typedef struct CompSlot {
+    uint64_t c_id;
+    uint32_t c_kind;
+    int32_t c_status;    /* 0 or -errno */
+    double c_t_ready;
+    char *c_payload;     /* malloc'd; consumer frees */
+    uint32_t c_len;
+} CompSlot;
+
+typedef struct PollEv {
+    Reg *reg;
+    uint32_t gen;
+    uint32_t revents;
+} PollEv;
+
+#ifdef CUEBALL_HAVE_IO_URING
+typedef struct UrRing {
+    int fd;
+    unsigned sq_entries;
+    unsigned cq_entries;
+    unsigned *k_sq_head, *k_sq_tail, *k_sq_mask, *k_sq_array;
+    unsigned *k_cq_head, *k_cq_tail, *k_cq_mask;
+    struct io_uring_cqe *cqes;
+    struct io_uring_sqe *sqes;
+    void *sq_ring;
+    void *cq_ring;
+    size_t sq_ring_sz, cq_ring_sz, sqes_sz;
+    int single_mmap;
+    unsigned pending;          /* filled sqes not yet submitted */
+    struct __kernel_timespec to_ts;
+    int to_armed;              /* a TIMEOUT op is outstanding     */
+    double to_abs;             /* its absolute deadline (mono ms) */
+} UrRing;
+
+#define UR_UD_TIMEOUT (~0ULL)
+#define UR_UD_IGNORE  (~0ULL - 1)
+#endif
+
+enum { BK_EPOLL = 0, BK_URING = 1 };
+
+typedef struct {
+    PyObject_HEAD
+    int backend;
+    uint32_t ring_cap;         /* power of two */
+    int comp_fd;               /* C -> Python wake eventfd  */
+    int sub_fd;                /* Python -> C wake eventfd  */
+    int ep_fd;
+#ifdef CUEBALL_HAVE_IO_URING
+    UrRing ur;
+    int ur_ok;
+#endif
+    pthread_t thread;
+    int thread_started;
+    int shut_down;
+
+    pthread_mutex_t mu;
+    SubMsg *sub_head, *sub_tail;
+    int stopping;
+    TxConn *conn_tab[CB_CONN_BUCKETS];
+    uint64_t next_id;
+
+    CompSlot *ring;
+    _Atomic uint64_t comp_head;
+    _Atomic uint64_t comp_tail;
+    _Atomic int comp_armed;
+
+    /* C-thread-only.  regs is a table of POINTERS to individually
+       malloc'd Reg structs: conns and poller user_data hold Reg*
+       across table growth, so the structs themselves must never
+       move (a flat realloc'd array dangled every outstanding
+       conn->reg when the table doubled). */
+    Reg **regs;
+    uint32_t regs_cap;
+    uint32_t *reg_free;
+    uint32_t reg_free_n;
+    TxOp **heap;
+    uint32_t heap_len, heap_cap;
+
+    _Atomic uint64_t st_wakeups, st_ring_stalls, st_inline_writes,
+        st_buffered_writes, st_drains, st_comp_highwater, st_polls;
+    _Atomic uint64_t wire[SEAM_N][WF_N];
+} TxLoopObject;
+
+#define WIRE_ADD(lp, seam, f, n) \
+    atomic_fetch_add_explicit(&(lp)->wire[seam][f], (uint64_t)(n), \
+                              memory_order_relaxed)
+#define ST_INC(lp, f) \
+    atomic_fetch_add_explicit(&(lp)->st_##f, 1, memory_order_relaxed)
+
+static double
+tx_now_ms(void)
+{
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (double)ts.tv_sec * 1000.0 + (double)ts.tv_nsec / 1e6;
+}
+
+/* ------------------------------------------------------------------ */
+/* ByteBuf                                                            */
+
+static int
+buf_append(ByteBuf *b, const char *p, size_t n)
+{
+    if (n == 0)
+        return 0;
+    if (b->len + n > b->cap) {
+        size_t want = b->len + n;
+        size_t cap = b->cap ? b->cap : 4096;
+        while (cap < want)
+            cap *= 2;
+        char *np = realloc(b->p, cap);
+        if (np == NULL)
+            return -1;
+        b->p = np;
+        b->cap = cap;
+    }
+    memcpy(b->p + b->len, p, n);
+    b->len += n;
+    return 0;
+}
+
+static inline size_t
+buf_avail(const ByteBuf *b)
+{
+    return b->len - b->off;
+}
+
+static void
+buf_consume(ByteBuf *b, size_t n)
+{
+    b->off += n;
+    if (b->off == b->len) {
+        b->off = b->len = 0;
+    } else if (b->off > 65536) {
+        memmove(b->p, b->p + b->off, b->len - b->off);
+        b->len -= b->off;
+        b->off = 0;
+    }
+}
+
+static void
+buf_release(ByteBuf *b)
+{
+    free(b->p);
+    b->p = NULL;
+    b->cap = b->len = b->off = 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* Completion ring: single C producer, single Python consumer.        */
+
+static void
+comp_wake(TxLoopObject *lp)
+{
+    if (atomic_exchange_explicit(&lp->comp_armed, 1,
+                                 memory_order_acq_rel) == 0) {
+        uint64_t one = 1;
+        ssize_t r = write(lp->comp_fd, &one, sizeof one);
+        (void)r;
+        ST_INC(lp, wakeups);
+    }
+}
+
+/* Producer side (C thread only).  Blocks briefly (with a wake) when
+   the ring is full; drops the completion when the loop is stopping
+   (the consumer is gone). */
+static void
+comp_post(TxLoopObject *lp, uint32_t kind, uint64_t id, int32_t status,
+          double t_ready, char *payload, uint32_t len)
+{
+    uint64_t h = atomic_load_explicit(&lp->comp_head,
+                                      memory_order_relaxed);
+    for (;;) {
+        uint64_t t = atomic_load_explicit(&lp->comp_tail,
+                                          memory_order_acquire);
+        if (h - t < lp->ring_cap)
+            break;
+        ST_INC(lp, ring_stalls);
+        if (lp->stopping) {
+            free(payload);
+            return;
+        }
+        comp_wake(lp);
+        struct timespec ts = {0, 200000};
+        nanosleep(&ts, NULL);
+    }
+    CompSlot *s = &lp->ring[h & (lp->ring_cap - 1)];
+    s->c_id = id;
+    s->c_kind = kind;
+    s->c_status = status;
+    s->c_t_ready = t_ready;
+    s->c_payload = payload;
+    s->c_len = len;
+    atomic_store_explicit(&lp->comp_head, h + 1, memory_order_release);
+    uint64_t depth = h + 1 - atomic_load_explicit(&lp->comp_tail,
+                                                  memory_order_relaxed);
+    if (depth > atomic_load_explicit(&lp->st_comp_highwater,
+                                     memory_order_relaxed))
+        atomic_store_explicit(&lp->st_comp_highwater, depth,
+                              memory_order_relaxed);
+    comp_wake(lp);
+}
+
+/* ------------------------------------------------------------------ */
+/* Registration table (C thread only)                                 */
+
+static Reg *
+reg_alloc(TxLoopObject *lp, int fd, int kind, void *obj)
+{
+    if (lp->reg_free_n == 0) {
+        /* Only the pointer TABLE reallocs; live Reg structs stay
+           put, so outstanding Reg* handles survive growth. */
+        uint32_t ncap = lp->regs_cap ? lp->regs_cap * 2 : 64;
+        Reg **nr = realloc(lp->regs, ncap * sizeof(Reg *));
+        if (nr != NULL)
+            lp->regs = nr;
+        uint32_t *nf = realloc(lp->reg_free, ncap * sizeof(uint32_t));
+        if (nf != NULL)
+            lp->reg_free = nf;
+        if (nr == NULL || nf == NULL)
+            return NULL;
+        for (uint32_t i = lp->regs_cap; i < ncap; i++) {
+            Reg *slot = calloc(1, sizeof(Reg));
+            if (slot == NULL)
+                break;   /* partial growth is fine */
+            slot->idx = i;
+            lp->regs[i] = slot;
+            lp->reg_free[lp->reg_free_n++] = i;
+            lp->regs_cap = i + 1;
+        }
+        if (lp->reg_free_n == 0)
+            return NULL;
+    }
+    Reg *r = lp->regs[lp->reg_free[--lp->reg_free_n]];
+    r->fd = fd;
+    r->events = 0;
+    r->gen++;
+    r->kind = kind;
+    r->in_use = 1;
+    r->armed = 0;
+    r->obj = obj;
+    return r;
+}
+
+static void
+reg_release(TxLoopObject *lp, Reg *r)
+{
+    r->in_use = 0;
+    r->obj = NULL;
+    r->gen++;          /* stale events for the old tenant drop */
+    lp->reg_free[lp->reg_free_n++] = r->idx;
+}
+
+static inline uint64_t
+reg_key(const Reg *r)
+{
+    return ((uint64_t)r->gen << 32) | r->idx;
+}
+
+/* ------------------------------------------------------------------ */
+/* io_uring poller (POLL_ADD readiness mode, raw syscalls)            */
+
+#ifdef CUEBALL_HAVE_IO_URING
+
+static int
+sys_io_uring_setup(unsigned entries, struct io_uring_params *p)
+{
+    return (int)syscall(__NR_io_uring_setup, entries, p);
+}
+
+static int
+sys_io_uring_enter(int fd, unsigned to_submit, unsigned min_complete,
+                   unsigned flags)
+{
+    return (int)syscall(__NR_io_uring_enter, fd, to_submit,
+                        min_complete, flags, NULL, 0);
+}
+
+static void
+ur_close(UrRing *u)
+{
+    if (u->sq_ring && u->sq_ring != MAP_FAILED)
+        munmap(u->sq_ring, u->sq_ring_sz);
+    if (!u->single_mmap && u->cq_ring && u->cq_ring != MAP_FAILED)
+        munmap(u->cq_ring, u->cq_ring_sz);
+    if (u->sqes && (void *)u->sqes != MAP_FAILED)
+        munmap(u->sqes, u->sqes_sz);
+    if (u->fd >= 0)
+        close(u->fd);
+    memset(u, 0, sizeof *u);
+    u->fd = -1;
+}
+
+static int
+ur_init(UrRing *u)
+{
+    struct io_uring_params p;
+    memset(u, 0, sizeof *u);
+    u->fd = -1;
+    memset(&p, 0, sizeof p);
+    p.flags = IORING_SETUP_CQSIZE;
+    p.cq_entries = 4096;
+    int fd = sys_io_uring_setup(256, &p);
+    if (fd < 0) {
+        /* Older kernel without CQSIZE: retry plain. */
+        memset(&p, 0, sizeof p);
+        fd = sys_io_uring_setup(256, &p);
+        if (fd < 0)
+            return -1;
+    }
+    u->fd = fd;
+    /* Completions must not be droppable: a lost POLL cqe would
+       deadlock a conn forever (one-shot arming). */
+    if (!(p.features & IORING_FEAT_NODROP)) {
+        ur_close(u);
+        return -1;
+    }
+    u->sq_entries = p.sq_entries;
+    u->cq_entries = p.cq_entries;
+    u->sq_ring_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+    u->cq_ring_sz = p.cq_off.cqes
+        + p.cq_entries * sizeof(struct io_uring_cqe);
+    u->single_mmap = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+    if (u->single_mmap && u->cq_ring_sz > u->sq_ring_sz)
+        u->sq_ring_sz = u->cq_ring_sz;
+    u->sq_ring = mmap(NULL, u->sq_ring_sz, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, fd,
+                      IORING_OFF_SQ_RING);
+    if (u->sq_ring == MAP_FAILED) {
+        ur_close(u);
+        return -1;
+    }
+    if (u->single_mmap) {
+        u->cq_ring = u->sq_ring;
+    } else {
+        u->cq_ring = mmap(NULL, u->cq_ring_sz, PROT_READ | PROT_WRITE,
+                          MAP_SHARED | MAP_POPULATE, fd,
+                          IORING_OFF_CQ_RING);
+        if (u->cq_ring == MAP_FAILED) {
+            ur_close(u);
+            return -1;
+        }
+    }
+    u->sqes_sz = p.sq_entries * sizeof(struct io_uring_sqe);
+    u->sqes = mmap(NULL, u->sqes_sz, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES);
+    if ((void *)u->sqes == MAP_FAILED) {
+        ur_close(u);
+        return -1;
+    }
+    char *sq = u->sq_ring, *cq = u->cq_ring;
+    u->k_sq_head = (unsigned *)(sq + p.sq_off.head);
+    u->k_sq_tail = (unsigned *)(sq + p.sq_off.tail);
+    u->k_sq_mask = (unsigned *)(sq + p.sq_off.ring_mask);
+    u->k_sq_array = (unsigned *)(sq + p.sq_off.array);
+    u->k_cq_head = (unsigned *)(cq + p.cq_off.head);
+    u->k_cq_tail = (unsigned *)(cq + p.cq_off.tail);
+    u->k_cq_mask = (unsigned *)(cq + p.cq_off.ring_mask);
+    u->cqes = (struct io_uring_cqe *)(cq + p.cq_off.cqes);
+    return 0;
+}
+
+static void
+ur_flush(UrRing *u)
+{
+    while (u->pending) {
+        int r = sys_io_uring_enter(u->fd, u->pending, 0, 0);
+        if (r >= 0) {
+            u->pending -= (unsigned)r;
+            if (r == 0)
+                break;
+        } else if (errno == EINTR || errno == EAGAIN
+                   || errno == EBUSY) {
+            struct timespec ts = {0, 100000};
+            nanosleep(&ts, NULL);
+        } else {
+            u->pending = 0;
+            break;
+        }
+    }
+}
+
+static struct io_uring_sqe *
+ur_sqe(UrRing *u)
+{
+    unsigned head = __atomic_load_n(u->k_sq_head, __ATOMIC_ACQUIRE);
+    unsigned tail = *u->k_sq_tail;
+    if (tail - head >= u->sq_entries) {
+        ur_flush(u);
+        head = __atomic_load_n(u->k_sq_head, __ATOMIC_ACQUIRE);
+        tail = *u->k_sq_tail;
+        if (tail - head >= u->sq_entries)
+            return NULL;    /* kernel badly behind; drop the sqe */
+    }
+    unsigned idx = tail & *u->k_sq_mask;
+    struct io_uring_sqe *sqe = &u->sqes[idx];
+    memset(sqe, 0, sizeof *sqe);
+    u->k_sq_array[idx] = idx;
+    __atomic_store_n(u->k_sq_tail, tail + 1, __ATOMIC_RELEASE);
+    u->pending++;
+    return sqe;
+}
+
+static void
+ur_poll_remove(UrRing *u, uint64_t key)
+{
+    struct io_uring_sqe *sqe = ur_sqe(u);
+    if (sqe == NULL)
+        return;
+    sqe->opcode = IORING_OP_POLL_REMOVE;
+    sqe->fd = -1;
+    sqe->addr = key;
+    sqe->user_data = UR_UD_IGNORE;
+}
+
+static void
+ur_poll_add(UrRing *u, Reg *r)
+{
+    struct io_uring_sqe *sqe = ur_sqe(u);
+    if (sqe == NULL)
+        return;
+    sqe->opcode = IORING_OP_POLL_ADD;
+    sqe->fd = r->fd;
+    sqe->poll_events = (unsigned short)r->events;
+    sqe->user_data = reg_key(r);
+    r->armed = 1;
+}
+
+#endif /* CUEBALL_HAVE_IO_URING */
+
+/* ------------------------------------------------------------------ */
+/* Poller facade: epoll level-triggered, or io_uring one-shot POLL.   */
+
+static int
+poller_set(TxLoopObject *lp, Reg *r, uint32_t events)
+{
+#ifdef CUEBALL_HAVE_IO_URING
+    if (lp->backend == BK_URING) {
+        if (r->armed)
+            ur_poll_remove(&lp->ur, reg_key(r));
+        r->armed = 0;
+        r->events = events;
+        if (events)
+            ur_poll_add(&lp->ur, r);
+        return 0;
+    }
+#endif
+    struct epoll_event ev;
+    memset(&ev, 0, sizeof ev);
+    ev.events = events;
+    ev.data.u64 = reg_key(r);
+    int op;
+    if (events == 0)
+        op = EPOLL_CTL_DEL;
+    else if (r->events == 0)
+        op = EPOLL_CTL_ADD;
+    else
+        op = EPOLL_CTL_MOD;
+    int rc = epoll_ctl(lp->ep_fd, op, r->fd, &ev);
+    if (rc < 0 && op == EPOLL_CTL_DEL && errno == ENOENT)
+        rc = 0;
+    if (rc == 0)
+        r->events = events;
+    return rc;
+}
+
+/* io_uring POLL_ADD is one-shot: after its cqe fires the interest is
+   consumed.  Called for each reg whose event was just handled. */
+static void
+poller_rearm(TxLoopObject *lp, Reg *r, uint32_t gen)
+{
+#ifdef CUEBALL_HAVE_IO_URING
+    if (lp->backend == BK_URING && r->in_use && r->gen == gen
+        && r->events != 0 && !r->armed)
+        ur_poll_add(&lp->ur, r);
+#else
+    (void)lp; (void)r; (void)gen;
+#endif
+}
+
+static int
+poller_wait(TxLoopObject *lp, PollEv *out, int max, int timeout_ms)
+{
+    int n = 0;
+#ifdef CUEBALL_HAVE_IO_URING
+    if (lp->backend == BK_URING) {
+        UrRing *u = &lp->ur;
+        /* At most one pure-timeout op outstanding — and only touched
+           when the wanted deadline actually moved.  (A REMOVE sqe
+           posts its own cqe, which would satisfy min_complete=1 and
+           busy-spin the loop if pushed every round.) */
+        double now = tx_now_ms();
+        if (timeout_ms >= 0) {
+            double abs_ms = now + (double)timeout_ms;
+            if (!u->to_armed || abs_ms < u->to_abs - 0.5
+                || abs_ms > u->to_abs + 0.5) {
+                struct io_uring_sqe *sqe;
+                if (u->to_armed) {
+                    sqe = ur_sqe(u);
+                    if (sqe != NULL) {
+                        sqe->opcode = IORING_OP_TIMEOUT_REMOVE;
+                        sqe->fd = -1;
+                        sqe->addr = UR_UD_TIMEOUT;
+                        sqe->user_data = UR_UD_IGNORE;
+                    }
+                }
+                u->to_ts.tv_sec = timeout_ms / 1000;
+                u->to_ts.tv_nsec =
+                    (long long)(timeout_ms % 1000) * 1000000LL;
+                sqe = ur_sqe(u);
+                if (sqe != NULL) {
+                    sqe->opcode = IORING_OP_TIMEOUT;
+                    sqe->fd = -1;
+                    sqe->addr =
+                        (unsigned long long)(uintptr_t)&u->to_ts;
+                    sqe->len = 1;
+                    sqe->off = 0;
+                    sqe->user_data = UR_UD_TIMEOUT;
+                    u->to_armed = 1;
+                    u->to_abs = abs_ms;
+                }
+            }
+        } else if (u->to_armed) {
+            struct io_uring_sqe *sqe = ur_sqe(u);
+            if (sqe != NULL) {
+                sqe->opcode = IORING_OP_TIMEOUT_REMOVE;
+                sqe->fd = -1;
+                sqe->addr = UR_UD_TIMEOUT;
+                sqe->user_data = UR_UD_IGNORE;
+                u->to_armed = 0;
+            }
+        }
+        unsigned to_submit = u->pending;
+        int rc;
+        do {
+            rc = sys_io_uring_enter(u->fd, to_submit, 1,
+                                    IORING_ENTER_GETEVENTS);
+            if (rc >= 0) {
+                if (to_submit >= (unsigned)rc)
+                    to_submit -= (unsigned)rc;
+                else
+                    to_submit = 0;
+                u->pending = to_submit;
+            }
+        } while (rc < 0 && errno == EINTR);
+        unsigned head = *u->k_cq_head;
+        unsigned tail = __atomic_load_n(u->k_cq_tail,
+                                        __ATOMIC_ACQUIRE);
+        while (head != tail && n < max) {
+            struct io_uring_cqe *cqe =
+                &u->cqes[head & *u->k_cq_mask];
+            uint64_t ud = cqe->user_data;
+            int32_t res = cqe->res;
+            head++;
+            if (ud == UR_UD_TIMEOUT) {
+                u->to_armed = 0;
+                continue;
+            }
+            if (ud == UR_UD_IGNORE)
+                continue;
+            uint32_t idx = (uint32_t)(ud & 0xFFFFFFFFu);
+            uint32_t gen = (uint32_t)(ud >> 32);
+            if (idx >= lp->regs_cap)
+                continue;
+            Reg *r = lp->regs[idx];
+            if (!r->in_use || r->gen != gen)
+                continue;
+            r->armed = 0;
+            if (res == -ECANCELED)
+                continue;
+            out[n].reg = r;
+            out[n].gen = gen;
+            out[n].revents = res < 0 ? (uint32_t)POLLERR
+                                     : (uint32_t)res;
+            n++;
+        }
+        __atomic_store_n(u->k_cq_head, head, __ATOMIC_RELEASE);
+        ST_INC(lp, polls);
+        return n;
+    }
+#endif
+    struct epoll_event evs[CB_MAX_POLL_EVENTS];
+    if (max > CB_MAX_POLL_EVENTS)
+        max = CB_MAX_POLL_EVENTS;
+    int rc = epoll_wait(lp->ep_fd, evs, max, timeout_ms);
+    if (rc < 0) {
+        if (errno == EINTR)
+            return 0;
+        return -1;
+    }
+    for (int i = 0; i < rc; i++) {
+        uint32_t idx = (uint32_t)(evs[i].data.u64 & 0xFFFFFFFFu);
+        uint32_t gen = (uint32_t)(evs[i].data.u64 >> 32);
+        if (idx >= lp->regs_cap)
+            continue;
+        Reg *r = lp->regs[idx];
+        if (!r->in_use || r->gen != gen)
+            continue;
+        out[n].reg = r;
+        out[n].gen = gen;
+        out[n].revents = evs[i].events;
+        n++;
+    }
+    ST_INC(lp, polls);
+    return n;
+}
+
+/* ------------------------------------------------------------------ */
+/* Deadline min-heap (C thread only)                                  */
+
+static void
+heap_swap(TxLoopObject *lp, uint32_t a, uint32_t b)
+{
+    TxOp *t = lp->heap[a];
+    lp->heap[a] = lp->heap[b];
+    lp->heap[b] = t;
+    lp->heap[a]->heap_idx = (int)a;
+    lp->heap[b]->heap_idx = (int)b;
+}
+
+static void
+heap_sift_up(TxLoopObject *lp, uint32_t i)
+{
+    while (i > 0) {
+        uint32_t p = (i - 1) / 2;
+        if (lp->heap[p]->deadline <= lp->heap[i]->deadline)
+            break;
+        heap_swap(lp, p, i);
+        i = p;
+    }
+}
+
+static void
+heap_sift_down(TxLoopObject *lp, uint32_t i)
+{
+    for (;;) {
+        uint32_t l = 2 * i + 1, r = 2 * i + 2, m = i;
+        if (l < lp->heap_len
+            && lp->heap[l]->deadline < lp->heap[m]->deadline)
+            m = l;
+        if (r < lp->heap_len
+            && lp->heap[r]->deadline < lp->heap[m]->deadline)
+            m = r;
+        if (m == i)
+            break;
+        heap_swap(lp, m, i);
+        i = m;
+    }
+}
+
+static int
+heap_push(TxLoopObject *lp, TxOp *op)
+{
+    if (lp->heap_len == lp->heap_cap) {
+        uint32_t ncap = lp->heap_cap ? lp->heap_cap * 2 : 64;
+        TxOp **nh = realloc(lp->heap, ncap * sizeof(TxOp *));
+        if (nh == NULL)
+            return -1;
+        lp->heap = nh;
+        lp->heap_cap = ncap;
+    }
+    lp->heap[lp->heap_len] = op;
+    op->heap_idx = (int)lp->heap_len;
+    lp->heap_len++;
+    heap_sift_up(lp, lp->heap_len - 1);
+    return 0;
+}
+
+static void
+heap_remove(TxLoopObject *lp, TxOp *op)
+{
+    if (op->heap_idx < 0)
+        return;
+    uint32_t i = (uint32_t)op->heap_idx;
+    op->heap_idx = -1;
+    lp->heap_len--;
+    if (i == lp->heap_len)
+        return;
+    lp->heap[i] = lp->heap[lp->heap_len];
+    lp->heap[i]->heap_idx = (int)i;
+    heap_sift_down(lp, i);
+    heap_sift_up(lp, i);
+}
+
+static TxOp *
+heap_pop(TxLoopObject *lp)
+{
+    if (lp->heap_len == 0)
+        return NULL;
+    TxOp *op = lp->heap[0];
+    heap_remove(lp, op);
+    return op;
+}
+
+/* ------------------------------------------------------------------ */
+/* Conn table (mu held)                                               */
+
+static TxConn *
+conn_find(TxLoopObject *lp, uint64_t id)
+{
+    TxConn *c = lp->conn_tab[id % CB_CONN_BUCKETS];
+    while (c != NULL && c->id != id)
+        c = c->next;
+    return c;
+}
+
+static void
+conn_insert(TxLoopObject *lp, TxConn *c)
+{
+    TxConn **slot = &lp->conn_tab[c->id % CB_CONN_BUCKETS];
+    c->next = *slot;
+    *slot = c;
+}
+
+static void
+conn_unlink(TxLoopObject *lp, TxConn *c)
+{
+    TxConn **pp = &lp->conn_tab[c->id % CB_CONN_BUCKETS];
+    while (*pp != NULL) {
+        if (*pp == c) {
+            *pp = c->next;
+            return;
+        }
+        pp = &(*pp)->next;
+    }
+}
+
+/* ------------------------------------------------------------------ */
+/* Submission queue: Python producer (GIL + mu), C thread consumer.   */
+
+static int
+tx_submit(TxLoopObject *lp, int kind, void *obj)
+{
+    SubMsg *m = malloc(sizeof *m);
+    if (m == NULL)
+        return -1;
+    m->kind = kind;
+    m->obj = obj;
+    m->next = NULL;
+    pthread_mutex_lock(&lp->mu);
+    if (lp->sub_tail != NULL)
+        lp->sub_tail->next = m;
+    else
+        lp->sub_head = m;
+    lp->sub_tail = m;
+    pthread_mutex_unlock(&lp->mu);
+    uint64_t one = 1;
+    ssize_t r = write(lp->sub_fd, &one, sizeof one);
+    (void)r;
+    return 0;
+}
+
+static void
+op_free(TxOp *op)
+{
+    buf_release(&op->out);
+    buf_release(&op->in);
+    free(op);
+}
+
+static void
+conn_free(TxConn *c)
+{
+    buf_release(&c->rbuf);
+    buf_release(&c->wbuf);
+    free(c);
+}
+
+/* ------------------------------------------------------------------ */
+/* C-thread event handlers                                            */
+
+/* Tear down a conn's fd/registration and fail any pending read.
+   Does NOT post a completion for the conn itself — callers decide
+   which kind (CONNECT-fail / ERROR / CLOSE) describes the teardown. */
+static void
+conn_close_fd(TxLoopObject *lp, TxConn *conn, int read_err)
+{
+    if (conn->reg != NULL) {
+        poller_set(lp, conn->reg, 0);
+        reg_release(lp, conn->reg);
+        conn->reg = NULL;
+    }
+    pthread_mutex_lock(&lp->mu);
+    if (conn->fd >= 0) {
+        close(conn->fd);
+        conn->fd = -1;
+    }
+    conn->state = CS_CLOSED;
+    TxOp *rd = conn->pending_read;
+    conn->pending_read = NULL;
+    pthread_mutex_unlock(&lp->mu);
+    if (conn->connect_op != NULL) {
+        heap_remove(lp, conn->connect_op);
+        op_free(conn->connect_op);
+        conn->connect_op = NULL;
+    }
+    if (rd != NULL) {
+        heap_remove(lp, rd);
+        comp_post(lp, CB_COMP_READ, rd->id, -read_err, 0.0, NULL, 0);
+        if (rd->sm_pending)
+            rd->done_early = 1;  /* sm_read() frees */
+        else
+            op_free(rd);
+    }
+}
+
+static void
+conn_connect_done(TxLoopObject *lp, TxConn *conn)
+{
+    int soerr = 0;
+    socklen_t slen = sizeof soerr;
+    if (getsockopt(conn->fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) < 0)
+        soerr = errno;
+    if (soerr != 0) {
+        WIRE_ADD(lp, SEAM_CONN, WF_ERRORS, 1);
+        conn_close_fd(lp, conn, ECONNRESET);
+        comp_post(lp, CB_COMP_CONNECT, conn->id, -soerr, 0.0, NULL, 0);
+        return;
+    }
+    double t_ready = tx_now_ms();
+    pthread_mutex_lock(&lp->mu);
+    conn->state = CS_OPEN;
+    int want_out = buf_avail(&conn->wbuf) > 0;
+    pthread_mutex_unlock(&lp->mu);
+    if (conn->connect_op != NULL) {
+        heap_remove(lp, conn->connect_op);
+        op_free(conn->connect_op);
+        conn->connect_op = NULL;
+    }
+    poller_set(lp, conn->reg,
+               (uint32_t)(POLLIN | (want_out ? POLLOUT : 0)));
+    WIRE_ADD(lp, SEAM_CONN, WF_CONNECTS, 1);
+    comp_post(lp, CB_COMP_CONNECT, conn->id, 0, t_ready, NULL, 0);
+}
+
+static void
+conn_flush_wbuf(TxLoopObject *lp, TxConn *conn)
+{
+    int err = 0, drained = 0;
+    pthread_mutex_lock(&lp->mu);
+    while (buf_avail(&conn->wbuf) > 0) {
+        ssize_t n = send(conn->fd, conn->wbuf.p + conn->wbuf.off,
+                         buf_avail(&conn->wbuf),
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (n > 0) {
+            WIRE_ADD(lp, SEAM_CONN, WF_BYTES_OUT, n);
+            ST_INC(lp, buffered_writes);
+            buf_consume(&conn->wbuf, (size_t)n);
+            continue;
+        }
+        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK))
+            break;
+        if (n < 0 && errno == EINTR)
+            continue;
+        err = n < 0 ? errno : EPIPE;
+        break;
+    }
+    drained = buf_avail(&conn->wbuf) == 0;
+    pthread_mutex_unlock(&lp->mu);
+    if (err != 0) {
+        WIRE_ADD(lp, SEAM_CONN, WF_ERRORS, 1);
+        conn_close_fd(lp, conn, err);
+        comp_post(lp, CB_COMP_ERROR, conn->id, -err, 0.0, NULL, 0);
+        return;
+    }
+    uint32_t want = (uint32_t)(POLLIN | (drained ? 0 : POLLOUT));
+    if (conn->reg != NULL && conn->reg->events != want)
+        poller_set(lp, conn->reg, want);
+}
+
+static void
+conn_readable(TxLoopObject *lp, TxConn *conn)
+{
+    char tmp[CB_READ_CHUNK];
+    for (;;) {
+        ssize_t n = recv(conn->fd, tmp, sizeof tmp, MSG_DONTWAIT);
+        if (n > 0) {
+            WIRE_ADD(lp, SEAM_CONN, WF_READS, 1);
+            WIRE_ADD(lp, SEAM_CONN, WF_BYTES_IN, n);
+            TxOp *done = NULL;
+            char *payload = NULL;
+            int post_data = 0, paused = 0, oom = 0;
+            pthread_mutex_lock(&lp->mu);
+            if (buf_append(&conn->rbuf, tmp, (size_t)n) < 0) {
+                oom = 1;
+            } else if (conn->pending_read != NULL
+                       && buf_avail(&conn->rbuf)
+                              >= conn->pending_read->want) {
+                done = conn->pending_read;
+                conn->pending_read = NULL;
+                payload = malloc(done->want ? done->want : 1);
+                if (payload != NULL) {
+                    memcpy(payload, conn->rbuf.p + conn->rbuf.off,
+                           done->want);
+                    buf_consume(&conn->rbuf, done->want);
+                } else {
+                    oom = 1;
+                }
+            } else if (conn->pending_read == NULL
+                       && !conn->data_posted) {
+                conn->data_posted = 1;
+                post_data = 1;
+            }
+            if (buf_avail(&conn->rbuf) >= CB_RBUF_MAX) {
+                conn->rd_paused = 1;
+                paused = 1;
+            }
+            pthread_mutex_unlock(&lp->mu);
+            if (oom) {
+                WIRE_ADD(lp, SEAM_CONN, WF_ERRORS, 1);
+                conn_close_fd(lp, conn, ENOMEM);
+                comp_post(lp, CB_COMP_ERROR, conn->id, -ENOMEM, 0.0,
+                          NULL, 0);
+                return;
+            }
+            if (done != NULL) {
+                heap_remove(lp, done);
+                comp_post(lp, CB_COMP_READ, done->id, 0, 0.0, payload,
+                          (uint32_t)done->want);
+                if (done->sm_pending)
+                    done->done_early = 1;  /* sm_read() frees */
+                else
+                    op_free(done);
+            }
+            if (post_data)
+                comp_post(lp, CB_COMP_DATA, conn->id, 0, 0.0, NULL, 0);
+            if (paused) {
+                poller_set(lp, conn->reg,
+                           conn->reg->events & ~(uint32_t)POLLIN);
+                return;
+            }
+            continue;
+        }
+        if (n == 0) {
+            /* Orderly EOF from the remote. */
+            WIRE_ADD(lp, SEAM_CONN, WF_CLOSES, 1);
+            conn_close_fd(lp, conn, ECONNRESET);
+            if (!conn->close_posted) {
+                conn->close_posted = 1;
+                comp_post(lp, CB_COMP_CLOSE, conn->id, 0, 0.0, NULL,
+                          0);
+            }
+            return;
+        }
+        if (errno == EAGAIN || errno == EWOULDBLOCK)
+            return;
+        if (errno == EINTR)
+            continue;
+        int err = errno;
+        WIRE_ADD(lp, SEAM_CONN, WF_ERRORS, 1);
+        conn_close_fd(lp, conn, err);
+        comp_post(lp, CB_COMP_ERROR, conn->id, -err, 0.0, NULL, 0);
+        return;
+    }
+}
+
+static void
+conn_event(TxLoopObject *lp, TxConn *conn, uint32_t revents)
+{
+    if (conn->state == CS_CONNECTING) {
+        if (revents & (POLLOUT | POLLERR | POLLHUP))
+            conn_connect_done(lp, conn);
+        return;
+    }
+    if (conn->state != CS_OPEN)
+        return;
+    if (revents & (POLLIN | POLLERR | POLLHUP)) {
+        conn_readable(lp, conn);
+        if (conn->state != CS_OPEN)
+            return;
+    }
+    if (revents & POLLOUT)
+        conn_flush_wbuf(lp, conn);
+}
+
+/* ------------------------------------------------------------------ */
+/* DNS ops                                                            */
+
+static void
+dns_cleanup(TxLoopObject *lp, TxOp *op)
+{
+    if (op->reg != NULL) {
+        poller_set(lp, op->reg, 0);
+        reg_release(lp, op->reg);
+        op->reg = NULL;
+    }
+    if (op->fd >= 0) {
+        close(op->fd);
+        op->fd = -1;
+    }
+    heap_remove(lp, op);
+}
+
+static void
+dns_fail(TxLoopObject *lp, TxOp *op, int err)
+{
+    int seam = op->kind == OP_DNS_UDP ? SEAM_UDP : SEAM_TCP;
+    uint32_t kind = op->kind == OP_DNS_UDP ? CB_COMP_DNS_UDP
+                                           : CB_COMP_DNS_TCP;
+    WIRE_ADD(lp, seam, WF_ERRORS, 1);
+    dns_cleanup(lp, op);
+    comp_post(lp, kind, op->id, -err, 0.0, NULL, 0);
+    op_free(op);
+}
+
+static void
+dns_done(TxLoopObject *lp, TxOp *op, const char *p, size_t n)
+{
+    uint32_t kind = op->kind == OP_DNS_UDP ? CB_COMP_DNS_UDP
+                                           : CB_COMP_DNS_TCP;
+    char *payload = malloc(n ? n : 1);
+    if (payload == NULL) {
+        dns_fail(lp, op, ENOMEM);
+        return;
+    }
+    /* Protocol-shaped read accounting, stamped once per completed
+       exchange (not per recv syscall): the asyncio and fabric arms
+       count one datagram in, or length-prefix + body for TCP, and
+       the wire-ledger parity gate compares these fields exactly. */
+    if (op->kind == OP_DNS_UDP) {
+        WIRE_ADD(lp, SEAM_UDP, WF_READS, 1);
+        WIRE_ADD(lp, SEAM_UDP, WF_BYTES_IN, n);
+    } else {
+        WIRE_ADD(lp, SEAM_TCP, WF_READS, 2);
+        WIRE_ADD(lp, SEAM_TCP, WF_BYTES_IN, n + 2);
+    }
+    memcpy(payload, p, n);
+    dns_cleanup(lp, op);
+    comp_post(lp, kind, op->id, 0, 0.0, payload, (uint32_t)n);
+    op_free(op);
+}
+
+static void
+dns_udp_try_send(TxLoopObject *lp, TxOp *op)
+{
+    ssize_t n = send(op->fd, op->out.p + op->out.off,
+                     buf_avail(&op->out), MSG_NOSIGNAL | MSG_DONTWAIT);
+    if (n >= 0) {
+        buf_consume(&op->out, (size_t)n);
+        op->dns_state = DS_UDP_WAIT;
+        poller_set(lp, op->reg, POLLIN);
+        return;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        op->dns_state = DS_UDP_SEND;
+        poller_set(lp, op->reg, POLLOUT);
+        return;
+    }
+    dns_fail(lp, op, errno);
+}
+
+static void
+dns_udp_readable(TxLoopObject *lp, TxOp *op)
+{
+    char buf[65535];
+    for (;;) {
+        ssize_t n = recv(op->fd, buf, sizeof buf, MSG_DONTWAIT);
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK)
+                return;
+            if (errno == EINTR)
+                continue;
+            dns_fail(lp, op, errno);
+            return;
+        }
+        /* Datagrams whose id does not match the query are strays
+           from an earlier timed-out exchange: keep waiting. */
+        if (n >= 2
+            && ((uint16_t)((unsigned char)buf[0] << 8
+                           | (unsigned char)buf[1])) == op->qid) {
+            dns_done(lp, op, buf, (size_t)n);
+            return;
+        }
+    }
+}
+
+static void
+dns_tcp_write(TxLoopObject *lp, TxOp *op)
+{
+    while (buf_avail(&op->out) > 0) {
+        ssize_t n = send(op->fd, op->out.p + op->out.off,
+                         buf_avail(&op->out),
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (n > 0) {
+            buf_consume(&op->out, (size_t)n);
+            continue;
+        }
+        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            op->dns_state = DS_TCP_WRITE;
+            poller_set(lp, op->reg, POLLOUT);
+            return;
+        }
+        if (n < 0 && errno == EINTR)
+            continue;
+        dns_fail(lp, op, n < 0 ? errno : EPIPE);
+        return;
+    }
+    op->dns_state = DS_TCP_READ;
+    poller_set(lp, op->reg, POLLIN);
+}
+
+static void
+dns_tcp_connected(TxLoopObject *lp, TxOp *op)
+{
+    /* One connect + one framed write per exchange (the asyncio arm
+       counts after drain(); totals agree on every success path). */
+    WIRE_ADD(lp, SEAM_TCP, WF_CONNECTS, 1);
+    WIRE_ADD(lp, SEAM_TCP, WF_WRITES, 1);
+    WIRE_ADD(lp, SEAM_TCP, WF_BYTES_OUT, buf_avail(&op->out));
+    dns_tcp_write(lp, op);
+}
+
+static void
+dns_tcp_readable(TxLoopObject *lp, TxOp *op)
+{
+    char buf[16384];
+    for (;;) {
+        ssize_t n = recv(op->fd, buf, sizeof buf, MSG_DONTWAIT);
+        if (n < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK)
+                return;
+            if (errno == EINTR)
+                continue;
+            dns_fail(lp, op, errno);
+            return;
+        }
+        if (n == 0) {
+            dns_fail(lp, op, ECONNRESET);
+            return;
+        }
+        if (buf_append(&op->in, buf, (size_t)n) < 0) {
+            dns_fail(lp, op, ENOMEM);
+            return;
+        }
+        if (op->want == 0 && op->in.len >= 2)
+            op->want = (size_t)((unsigned char)op->in.p[0] << 8
+                                | (unsigned char)op->in.p[1]);
+        if (op->in.len >= 2 && op->in.len >= 2 + op->want) {
+            dns_done(lp, op, op->in.p + 2, op->want);
+            return;
+        }
+    }
+}
+
+static void
+dns_start(TxLoopObject *lp, TxOp *op)
+{
+    int type = op->kind == OP_DNS_UDP ? SOCK_DGRAM : SOCK_STREAM;
+    int fd = socket(op->addr.ss_family,
+                    type | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) {
+        dns_fail(lp, op, errno);
+        return;
+    }
+    op->fd = fd;
+    op->reg = reg_alloc(lp, fd, RK_DNS, op);
+    if (op->reg == NULL) {
+        dns_fail(lp, op, ENOMEM);
+        return;
+    }
+    int rc = connect(fd, (struct sockaddr *)&op->addr, op->addrlen);
+    if (rc < 0 && errno != EINPROGRESS) {
+        dns_fail(lp, op, errno);
+        return;
+    }
+    if (op->kind == OP_DNS_UDP) {
+        dns_udp_try_send(lp, op);
+        return;
+    }
+    if (rc == 0) {
+        dns_tcp_connected(lp, op);
+    } else {
+        op->dns_state = DS_TCP_CONNECTING;
+        poller_set(lp, op->reg, POLLOUT);
+    }
+}
+
+static void
+dns_event(TxLoopObject *lp, TxOp *op, uint32_t revents)
+{
+    switch (op->dns_state) {
+    case DS_UDP_SEND:
+        if (revents & (POLLOUT | POLLERR | POLLHUP))
+            dns_udp_try_send(lp, op);
+        break;
+    case DS_UDP_WAIT:
+        if (revents & (POLLIN | POLLERR | POLLHUP))
+            dns_udp_readable(lp, op);
+        break;
+    case DS_TCP_CONNECTING: {
+        int soerr = 0;
+        socklen_t slen = sizeof soerr;
+        if (getsockopt(op->fd, SOL_SOCKET, SO_ERROR, &soerr,
+                       &slen) < 0)
+            soerr = errno;
+        if (soerr != 0)
+            dns_fail(lp, op, soerr);
+        else
+            dns_tcp_connected(lp, op);
+        break;
+    }
+    case DS_TCP_WRITE:
+        if (revents & (POLLOUT | POLLERR | POLLHUP))
+            dns_tcp_write(lp, op);
+        break;
+    case DS_TCP_READ:
+        if (revents & (POLLIN | POLLERR | POLLHUP))
+            dns_tcp_readable(lp, op);
+        break;
+    default:
+        break;
+    }
+}
+
+/* ------------------------------------------------------------------ */
+/* Deadlines and submissions (C thread)                               */
+
+static void
+op_deadline_fired(TxLoopObject *lp, TxOp *op)
+{
+    switch (op->kind) {
+    case OP_CONNECT: {
+        TxConn *conn = op->conn;
+        conn->connect_op = NULL;  /* conn_close_fd must not free us */
+        WIRE_ADD(lp, SEAM_CONN, WF_ERRORS, 1);
+        conn_close_fd(lp, conn, ETIMEDOUT);
+        comp_post(lp, CB_COMP_CONNECT, conn->id, -ETIMEDOUT, 0.0,
+                  NULL, 0);
+        op_free(op);
+        break;
+    }
+    case OP_READ: {
+        TxConn *conn = op->conn;
+        pthread_mutex_lock(&lp->mu);
+        if (conn->pending_read == op)
+            conn->pending_read = NULL;
+        pthread_mutex_unlock(&lp->mu);
+        comp_post(lp, CB_COMP_READ, op->id, -ETIMEDOUT, 0.0, NULL, 0);
+        op_free(op);
+        break;
+    }
+    case OP_DNS_UDP:
+    case OP_DNS_TCP:
+        dns_fail(lp, op, ETIMEDOUT);
+        break;
+    case OP_TIMER:
+        comp_post(lp, CB_COMP_TIMER, op->id, 0, 0.0, NULL, 0);
+        op_free(op);
+        break;
+    default:
+        op_free(op);
+        break;
+    }
+}
+
+static void
+sm_connect(TxLoopObject *lp, TxOp *op)
+{
+    TxConn *conn = op->conn;
+    int fd = socket(op->addr.ss_family,
+                    SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) {
+        int err = errno;
+        WIRE_ADD(lp, SEAM_CONN, WF_ERRORS, 1);
+        pthread_mutex_lock(&lp->mu);
+        conn->state = CS_CLOSED;
+        pthread_mutex_unlock(&lp->mu);
+        comp_post(lp, CB_COMP_CONNECT, conn->id, -err, 0.0, NULL, 0);
+        op_free(op);
+        return;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    pthread_mutex_lock(&lp->mu);
+    conn->fd = fd;
+    pthread_mutex_unlock(&lp->mu);
+    conn->reg = reg_alloc(lp, fd, RK_CONN, conn);
+    if (conn->reg == NULL) {
+        WIRE_ADD(lp, SEAM_CONN, WF_ERRORS, 1);
+        conn_close_fd(lp, conn, ENOMEM);
+        comp_post(lp, CB_COMP_CONNECT, conn->id, -ENOMEM, 0.0, NULL,
+                  0);
+        op_free(op);
+        return;
+    }
+    int rc = connect(fd, (struct sockaddr *)&op->addr, op->addrlen);
+    if (rc < 0 && errno != EINPROGRESS) {
+        int err = errno;
+        WIRE_ADD(lp, SEAM_CONN, WF_ERRORS, 1);
+        conn_close_fd(lp, conn, err);
+        comp_post(lp, CB_COMP_CONNECT, conn->id, -err, 0.0, NULL, 0);
+        op_free(op);
+        return;
+    }
+    if (op->deadline > 0.0) {
+        conn->connect_op = op;
+        if (heap_push(lp, op) < 0) {
+            conn->connect_op = NULL;
+            op_free(op);
+        }
+    } else {
+        op_free(op);
+    }
+    if (rc == 0) {
+        /* Loopback connects can land synchronously. */
+        poller_set(lp, conn->reg, POLLOUT);
+        conn_connect_done(lp, conn);
+    } else {
+        poller_set(lp, conn->reg, POLLOUT);
+    }
+}
+
+static void
+sm_read(TxLoopObject *lp, TxOp *op)
+{
+    TxConn *conn = op->conn;
+    TxOp *done = NULL;
+    char *payload = NULL;
+    int dead = 0, oom = 0;
+    op->sm_pending = 0;
+    if (op->done_early) {
+        /* conn_readable or the close path completed this op between
+           submission and dispatch; the completion is already posted
+           and the free was deferred to us (the op has to outlive its
+           queued SM_READ message). */
+        op_free(op);
+        return;
+    }
+    pthread_mutex_lock(&lp->mu);
+    if (conn->state == CS_CLOSED) {
+        if (conn->pending_read == op)
+            conn->pending_read = NULL;
+        dead = 1;
+    } else if (buf_avail(&conn->rbuf) >= op->want
+               && conn->pending_read == op) {
+        conn->pending_read = NULL;
+        done = op;
+        payload = malloc(op->want ? op->want : 1);
+        if (payload != NULL) {
+            memcpy(payload, conn->rbuf.p + conn->rbuf.off, op->want);
+            buf_consume(&conn->rbuf, op->want);
+        } else {
+            oom = 1;
+        }
+    }
+    pthread_mutex_unlock(&lp->mu);
+    if (dead) {
+        comp_post(lp, CB_COMP_READ, op->id, -ENOTCONN, 0.0, NULL, 0);
+        op_free(op);
+        return;
+    }
+    if (oom) {
+        comp_post(lp, CB_COMP_READ, op->id, -ENOMEM, 0.0, NULL, 0);
+        op_free(op);
+        return;
+    }
+    if (done != NULL) {
+        comp_post(lp, CB_COMP_READ, done->id, 0, 0.0, payload,
+                  (uint32_t)done->want);
+        op_free(done);
+        return;
+    }
+    if (op->deadline > 0.0 && heap_push(lp, op) < 0) {
+        pthread_mutex_lock(&lp->mu);
+        if (conn->pending_read == op)
+            conn->pending_read = NULL;
+        pthread_mutex_unlock(&lp->mu);
+        comp_post(lp, CB_COMP_READ, op->id, -ENOMEM, 0.0, NULL, 0);
+        op_free(op);
+    }
+}
+
+static void
+sm_want_read(TxLoopObject *lp, TxConn *conn)
+{
+    pthread_mutex_lock(&lp->mu);
+    int resume = conn->rd_paused && conn->state == CS_OPEN
+        && buf_avail(&conn->rbuf) < CB_RBUF_MAX;
+    if (resume)
+        conn->rd_paused = 0;
+    pthread_mutex_unlock(&lp->mu);
+    if (resume && conn->reg != NULL)
+        poller_set(lp, conn->reg, conn->reg->events | POLLIN);
+}
+
+/* Returns 1 when SM_STOP was seen. */
+static int
+process_submissions(TxLoopObject *lp)
+{
+    pthread_mutex_lock(&lp->mu);
+    SubMsg *m = lp->sub_head;
+    lp->sub_head = lp->sub_tail = NULL;
+    pthread_mutex_unlock(&lp->mu);
+    int stop = 0;
+    while (m != NULL) {
+        SubMsg *next = m->next;
+        switch (m->kind) {
+        case SM_CONNECT:
+            sm_connect(lp, m->obj);
+            break;
+        case SM_READ:
+            sm_read(lp, m->obj);
+            break;
+        case SM_WANT_WRITE: {
+            TxConn *conn = m->obj;
+            if (conn->state == CS_OPEN)
+                conn_flush_wbuf(lp, conn);
+            break;
+        }
+        case SM_WANT_READ:
+            sm_want_read(lp, m->obj);
+            break;
+        case SM_CLOSE: {
+            TxConn *conn = m->obj;
+            if (conn->state != CS_CLOSED)
+                conn_close_fd(lp, conn, ECONNRESET);
+            if (!conn->close_posted) {
+                conn->close_posted = 1;
+                comp_post(lp, CB_COMP_CLOSE, conn->id, 0, 0.0, NULL,
+                          0);
+            }
+            break;
+        }
+        case SM_RELEASE: {
+            TxConn *conn = m->obj;
+            if (conn->state != CS_CLOSED)
+                conn_close_fd(lp, conn, ECONNRESET);
+            pthread_mutex_lock(&lp->mu);
+            conn_unlink(lp, conn);
+            pthread_mutex_unlock(&lp->mu);
+            conn_free(conn);
+            break;
+        }
+        case SM_DNS: {
+            TxOp *op = m->obj;
+            /* Arm the deadline before starting: dns_fail()'s
+               cleanup path heap_remove()s, so a synchronous
+               failure inside dns_start unwinds this push. */
+            if (op->deadline > 0.0 && heap_push(lp, op) < 0) {
+                dns_fail(lp, op, ENOMEM);
+                break;
+            }
+            dns_start(lp, op);
+            break;
+        }
+        case SM_TIMER: {
+            TxOp *op = m->obj;
+            if (heap_push(lp, op) < 0) {
+                comp_post(lp, CB_COMP_TIMER, op->id, -ENOMEM, 0.0,
+                          NULL, 0);
+                op_free(op);
+            }
+            break;
+        }
+        case SM_STOP:
+            stop = 1;
+            break;
+        default:
+            break;
+        }
+        free(m);
+        m = next;
+    }
+    return stop;
+}
+
+static void *
+tx_thread_main(void *arg)
+{
+    TxLoopObject *lp = arg;
+    prctl(PR_SET_NAME, "cueball-tx", 0, 0, 0);
+    PollEv evs[CB_MAX_POLL_EVENTS];
+    int stop = 0;
+    while (!stop) {
+        double now = tx_now_ms();
+        while (lp->heap_len > 0 && lp->heap[0]->deadline <= now) {
+            TxOp *op = heap_pop(lp);
+            op_deadline_fired(lp, op);
+        }
+        int timeout_ms = -1;
+        if (lp->heap_len > 0) {
+            double delta = lp->heap[0]->deadline - now;
+            if (delta < 0.0)
+                delta = 0.0;
+            if (delta > 60000.0)
+                delta = 60000.0;
+            timeout_ms = (int)delta + 1;
+        }
+        int n = poller_wait(lp, evs, CB_MAX_POLL_EVENTS, timeout_ms);
+        for (int i = 0; i < n; i++) {
+            Reg *r = evs[i].reg;
+            if (!r->in_use || r->gen != evs[i].gen)
+                continue;
+            switch (r->kind) {
+            case RK_SUB: {
+                uint64_t junk;
+                while (read(lp->sub_fd, &junk, sizeof junk) > 0)
+                    ;
+                if (process_submissions(lp))
+                    stop = 1;
+                break;
+            }
+            case RK_CONN:
+                conn_event(lp, r->obj, evs[i].revents);
+                break;
+            case RK_DNS:
+                dns_event(lp, r->obj, evs[i].revents);
+                break;
+            default:
+                break;
+            }
+            poller_rearm(lp, evs[i].reg, evs[i].gen);
+        }
+    }
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
+/* Python-facing methods (GIL held; never block)                      */
+
+static int
+parse_numeric_addr(const char *host, int port, int socktype,
+                   struct sockaddr_storage *ss, socklen_t *len)
+{
+    struct addrinfo hints, *res = NULL;
+    char portbuf[16];
+    memset(&hints, 0, sizeof hints);
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = socktype;
+    hints.ai_flags = AI_NUMERICHOST | AI_NUMERICSERV;
+    snprintf(portbuf, sizeof portbuf, "%d", port);
+    if (getaddrinfo(host, portbuf, &hints, &res) != 0 || res == NULL)
+        return -1;
+    memcpy(ss, res->ai_addr, res->ai_addrlen);
+    *len = (socklen_t)res->ai_addrlen;
+    freeaddrinfo(res);
+    return 0;
+}
+
+static uint64_t
+tx_next_id(TxLoopObject *lp)
+{
+    pthread_mutex_lock(&lp->mu);
+    uint64_t id = ++lp->next_id;
+    pthread_mutex_unlock(&lp->mu);
+    return id;
+}
+
+static int
+tx_check_running(TxLoopObject *lp)
+{
+    if (!lp->thread_started || lp->shut_down || lp->stopping) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "transport loop is shut down");
+        return -1;
+    }
+    return 0;
+}
+
+static PyObject *
+txloop_connect(PyObject *self, PyObject *args)
+{
+    TxLoopObject *lp = (TxLoopObject *)self;
+    const char *host;
+    int port;
+    double timeout_ms = 0.0;
+    if (!PyArg_ParseTuple(args, "si|d:connect", &host, &port,
+                          &timeout_ms))
+        return NULL;
+    if (tx_check_running(lp) < 0)
+        return NULL;
+    TxOp *op = calloc(1, sizeof *op);
+    TxConn *conn = calloc(1, sizeof *conn);
+    if (op == NULL || conn == NULL) {
+        free(op);
+        free(conn);
+        return PyErr_NoMemory();
+    }
+    if (parse_numeric_addr(host, port, SOCK_STREAM, &op->addr,
+                           &op->addrlen) < 0) {
+        free(op);
+        free(conn);
+        return PyErr_Format(PyExc_ValueError,
+                            "not a numeric address: %s:%d", host,
+                            port);
+    }
+    double now = tx_now_ms();
+    op->kind = OP_CONNECT;
+    op->heap_idx = -1;
+    op->conn = conn;
+    op->id = tx_next_id(lp);
+    if (timeout_ms > 0.0)
+        op->deadline = now + timeout_ms;
+    conn->id = tx_next_id(lp);
+    conn->fd = -1;
+    conn->state = CS_CONNECTING;
+    pthread_mutex_lock(&lp->mu);
+    conn_insert(lp, conn);
+    pthread_mutex_unlock(&lp->mu);
+    WIRE_ADD(lp, SEAM_CONN, WF_EVENTS, 1);
+    cueball_wire_trace_emit(CB_WEV_CONNECTOR, now, (double)port, 0.0);
+    if (tx_submit(lp, SM_CONNECT, op) < 0) {
+        pthread_mutex_lock(&lp->mu);
+        conn_unlink(lp, conn);
+        pthread_mutex_unlock(&lp->mu);
+        free(op);
+        free(conn);
+        return PyErr_NoMemory();
+    }
+    return PyLong_FromUnsignedLongLong(conn->id);
+}
+
+static PyObject *
+txloop_write(PyObject *self, PyObject *args)
+{
+    TxLoopObject *lp = (TxLoopObject *)self;
+    unsigned long long conn_id;
+    Py_buffer buf;
+    if (!PyArg_ParseTuple(args, "Ky*:write", &conn_id, &buf))
+        return NULL;
+    if (tx_check_running(lp) < 0) {
+        PyBuffer_Release(&buf);
+        return NULL;
+    }
+    const char *p = buf.buf;
+    size_t len = (size_t)buf.len;
+    ssize_t inline_sent = 0;
+    int need_notify = 0, bad = 0, oom = 0;
+    pthread_mutex_lock(&lp->mu);
+    TxConn *conn = conn_find(lp, conn_id);
+    if (conn == NULL || conn->state == CS_CLOSED) {
+        bad = 1;
+    } else if (conn->state == CS_CONNECTING
+               || buf_avail(&conn->wbuf) > 0
+               || len > CB_INLINE_WRITE_MAX) {
+        /* Buffered large-write path: the C thread flushes on
+           POLLOUT (or on the open transition). */
+        if (buf_append(&conn->wbuf, p, len) < 0)
+            oom = 1;
+        else
+            need_notify = conn->state == CS_OPEN;
+    } else {
+        /* Inline small-write fast path: one nonblocking send from
+           the submitting thread, no crossing at all when the socket
+           accepts the full payload. */
+        ssize_t n = send(conn->fd, p, len,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+        if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK
+            && errno != EINTR)
+            n = 0;  /* real error surfaces on the C-thread flush */
+        if (n < 0)
+            n = 0;
+        inline_sent = n;
+        if ((size_t)n < len) {
+            if (buf_append(&conn->wbuf, p + n, len - (size_t)n) < 0)
+                oom = 1;
+            else
+                need_notify = 1;
+        }
+    }
+    pthread_mutex_unlock(&lp->mu);
+    PyBuffer_Release(&buf);
+    if (bad) {
+        errno = ENOTCONN;
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    if (oom)
+        return PyErr_NoMemory();
+    WIRE_ADD(lp, SEAM_CONN, WF_WRITES, 1);
+    if (inline_sent > 0) {
+        WIRE_ADD(lp, SEAM_CONN, WF_BYTES_OUT, inline_sent);
+        if (!need_notify)
+            ST_INC(lp, inline_writes);
+    }
+    if (need_notify && tx_submit(lp, SM_WANT_WRITE, conn) < 0)
+        return PyErr_NoMemory();
+    return PyLong_FromSsize_t(inline_sent);
+}
+
+static PyObject *
+txloop_read(PyObject *self, PyObject *args)
+{
+    TxLoopObject *lp = (TxLoopObject *)self;
+    unsigned long long conn_id;
+    Py_ssize_t want;
+    double timeout_ms = 0.0;
+    if (!PyArg_ParseTuple(args, "Kn|d:read", &conn_id, &want,
+                          &timeout_ms))
+        return NULL;
+    if (want < 0) {
+        PyErr_SetString(PyExc_ValueError, "negative read size");
+        return NULL;
+    }
+    if (tx_check_running(lp) < 0)
+        return NULL;
+    PyObject *fast = NULL;
+    int bad = 0, busy = 0, resume = 0;
+    TxConn *conn;
+    pthread_mutex_lock(&lp->mu);
+    conn = conn_find(lp, conn_id);
+    if (conn == NULL || conn->state == CS_CLOSED) {
+        bad = 1;
+    } else if (conn->pending_read != NULL) {
+        busy = 1;
+    } else if (buf_avail(&conn->rbuf) >= (size_t)want) {
+        /* Read-side fast path: satisfied from the buffer with zero
+           crossings. */
+        fast = PyBytes_FromStringAndSize(conn->rbuf.p + conn->rbuf.off,
+                                         want);
+        if (fast != NULL) {
+            buf_consume(&conn->rbuf, (size_t)want);
+            conn->data_posted = 0;
+            resume = conn->rd_paused
+                && buf_avail(&conn->rbuf) < CB_RBUF_MAX / 2;
+        }
+    }
+    pthread_mutex_unlock(&lp->mu);
+    if (bad) {
+        errno = ENOTCONN;
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    if (busy) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "a read is already pending on this conn");
+        return NULL;
+    }
+    if (fast != NULL) {
+        if (resume && tx_submit(lp, SM_WANT_READ, conn) < 0) {
+            Py_DECREF(fast);
+            return PyErr_NoMemory();
+        }
+        return fast;
+    }
+    if (PyErr_Occurred())
+        return NULL;
+    TxOp *op = calloc(1, sizeof *op);
+    if (op == NULL)
+        return PyErr_NoMemory();
+    op->kind = OP_READ;
+    op->heap_idx = -1;
+    op->conn = conn;
+    op->want = (size_t)want;
+    op->id = tx_next_id(lp);
+    if (timeout_ms > 0.0)
+        op->deadline = tx_now_ms() + timeout_ms;
+    pthread_mutex_lock(&lp->mu);
+    if (conn->pending_read != NULL || conn->state == CS_CLOSED) {
+        pthread_mutex_unlock(&lp->mu);
+        free(op);
+        PyErr_SetString(PyExc_RuntimeError, "conn state changed");
+        return NULL;
+    }
+    /* sm_pending must be set BEFORE pending_read publishes the op to
+       the C thread: it tells an early completer (response bytes or a
+       close racing ahead of the SM_READ dispatch) to defer the free
+       to sm_read() instead of freeing an op whose submission message
+       is still in flight. */
+    op->sm_pending = 1;
+    conn->pending_read = op;
+    uint64_t op_id = op->id;
+    pthread_mutex_unlock(&lp->mu);
+    if (tx_submit(lp, SM_READ, op) < 0) {
+        pthread_mutex_lock(&lp->mu);
+        if (conn->pending_read == op)
+            conn->pending_read = NULL;
+        pthread_mutex_unlock(&lp->mu);
+        free(op);
+        return PyErr_NoMemory();
+    }
+    /* NOT op->id: after tx_submit the C thread owns the op and its
+       fast path (bytes already buffered) completes and frees it
+       without ever taking the GIL — op may be dangling here. */
+    return PyLong_FromUnsignedLongLong(op_id);
+}
+
+static PyObject *
+txloop_read_available(PyObject *self, PyObject *args)
+{
+    TxLoopObject *lp = (TxLoopObject *)self;
+    unsigned long long conn_id;
+    if (!PyArg_ParseTuple(args, "K:read_available", &conn_id))
+        return NULL;
+    PyObject *out = NULL;
+    int resume = 0;
+    TxConn *conn;
+    pthread_mutex_lock(&lp->mu);
+    conn = conn_find(lp, conn_id);
+    if (conn != NULL) {
+        size_t n = buf_avail(&conn->rbuf);
+        out = PyBytes_FromStringAndSize(
+            n ? conn->rbuf.p + conn->rbuf.off : "", (Py_ssize_t)n);
+        if (out != NULL) {
+            buf_consume(&conn->rbuf, n);
+            conn->data_posted = 0;
+            resume = conn->rd_paused;
+        }
+    }
+    pthread_mutex_unlock(&lp->mu);
+    if (conn == NULL)
+        return PyBytes_FromStringAndSize("", 0);
+    if (out == NULL)
+        return NULL;
+    if (resume && tx_submit(lp, SM_WANT_READ, conn) < 0) {
+        Py_DECREF(out);
+        return PyErr_NoMemory();
+    }
+    return out;
+}
+
+static PyObject *
+txloop_close_conn(PyObject *self, PyObject *args)
+{
+    TxLoopObject *lp = (TxLoopObject *)self;
+    unsigned long long conn_id;
+    if (!PyArg_ParseTuple(args, "K:close_conn", &conn_id))
+        return NULL;
+    pthread_mutex_lock(&lp->mu);
+    TxConn *conn = conn_find(lp, conn_id);
+    pthread_mutex_unlock(&lp->mu);
+    if (conn == NULL || lp->shut_down)
+        Py_RETURN_NONE;
+    /* No WF_CLOSES here: the asyncio arm counts closes on the
+       'close' emit only (remote-initiated; destroy() suppresses the
+       emit), so the native ledger counts them at EOF and nowhere
+       else to stay comparable. */
+    /* FIFO guarantees CLOSE is processed before RELEASE frees. */
+    if (tx_submit(lp, SM_CLOSE, conn) < 0
+        || tx_submit(lp, SM_RELEASE, conn) < 0)
+        return PyErr_NoMemory();
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+tx_dns_common(TxLoopObject *lp, PyObject *args, int kind)
+{
+    const char *host;
+    int port;
+    Py_buffer payload;
+    double timeout_ms = 0.0;
+    if (!PyArg_ParseTuple(args, "siy*|d", &host, &port, &payload,
+                          &timeout_ms))
+        return NULL;
+    if (tx_check_running(lp) < 0 || payload.len < 2) {
+        if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_ValueError,
+                            "DNS payload shorter than its id");
+        PyBuffer_Release(&payload);
+        return NULL;
+    }
+    TxOp *op = calloc(1, sizeof *op);
+    if (op == NULL) {
+        PyBuffer_Release(&payload);
+        return PyErr_NoMemory();
+    }
+    op->kind = kind;
+    op->heap_idx = -1;
+    op->fd = -1;
+    int socktype = kind == OP_DNS_UDP ? SOCK_DGRAM : SOCK_STREAM;
+    if (parse_numeric_addr(host, port, socktype, &op->addr,
+                           &op->addrlen) < 0) {
+        free(op);
+        PyObject *e = PyErr_Format(PyExc_ValueError,
+                                   "not a numeric address: %s:%d",
+                                   host, port);
+        PyBuffer_Release(&payload);
+        return e;
+    }
+    const unsigned char *pp = payload.buf;
+    op->qid = (uint16_t)(pp[0] << 8 | pp[1]);
+    int rc = 0;
+    if (kind == OP_DNS_TCP) {
+        unsigned char hdr[2] = {
+            (unsigned char)((payload.len >> 8) & 0xFF),
+            (unsigned char)(payload.len & 0xFF),
+        };
+        rc |= buf_append(&op->out, (const char *)hdr, 2);
+    }
+    rc |= buf_append(&op->out, payload.buf, (size_t)payload.len);
+    PyBuffer_Release(&payload);
+    if (rc != 0) {
+        op_free(op);
+        return PyErr_NoMemory();
+    }
+    double now = tx_now_ms();
+    op->id = tx_next_id(lp);
+    if (timeout_ms > 0.0)
+        op->deadline = now + timeout_ms;
+    int seam = kind == OP_DNS_UDP ? SEAM_UDP : SEAM_TCP;
+    WIRE_ADD(lp, seam, WF_EVENTS, 1);
+    if (kind == OP_DNS_UDP) {
+        /* The asyncio arm counts the datagram out at submit, before
+           awaiting the reply (so a later timeout still shows the
+           write); TCP stamps its framed write at connect success. */
+        WIRE_ADD(lp, SEAM_UDP, WF_WRITES, 1);
+        WIRE_ADD(lp, SEAM_UDP, WF_BYTES_OUT, op->out.len);
+    }
+    cueball_wire_trace_emit(
+        kind == OP_DNS_UDP ? CB_WEV_DNS_UDP : CB_WEV_DNS_TCP, now,
+        (double)op->out.len, 0.0);
+    /* Once submitted the op belongs to the C thread, which can
+       complete and free it before we return (it never takes the
+       GIL): read the id out first. */
+    uint64_t op_id = op->id;
+    if (tx_submit(lp, SM_DNS, op) < 0) {
+        op_free(op);
+        return PyErr_NoMemory();
+    }
+    return PyLong_FromUnsignedLongLong(op_id);
+}
+
+static PyObject *
+txloop_dns_udp(PyObject *self, PyObject *args)
+{
+    return tx_dns_common((TxLoopObject *)self, args, OP_DNS_UDP);
+}
+
+static PyObject *
+txloop_dns_tcp(PyObject *self, PyObject *args)
+{
+    return tx_dns_common((TxLoopObject *)self, args, OP_DNS_TCP);
+}
+
+static PyObject *
+txloop_timer(PyObject *self, PyObject *args)
+{
+    TxLoopObject *lp = (TxLoopObject *)self;
+    double delay_ms;
+    if (!PyArg_ParseTuple(args, "d:timer", &delay_ms))
+        return NULL;
+    if (tx_check_running(lp) < 0)
+        return NULL;
+    TxOp *op = calloc(1, sizeof *op);
+    if (op == NULL)
+        return PyErr_NoMemory();
+    op->kind = OP_TIMER;
+    op->heap_idx = -1;
+    op->fd = -1;
+    op->id = tx_next_id(lp);
+    op->deadline = tx_now_ms() + (delay_ms > 0.0 ? delay_ms : 0.0);
+    if (op->deadline <= 0.0)
+        op->deadline = 1e-9;
+    /* Submission hands ownership to the C thread: a zero-delay timer
+       can fire and be freed before we return. */
+    uint64_t op_id = op->id;
+    if (tx_submit(lp, SM_TIMER, op) < 0) {
+        op_free(op);
+        return PyErr_NoMemory();
+    }
+    return PyLong_FromUnsignedLongLong(op_id);
+}
+
+/* ------------------------------------------------------------------ */
+/* Drain: the one pump crossing per tick                              */
+
+static PyObject *
+txloop_drain(PyObject *self, PyObject *args)
+{
+    TxLoopObject *lp = (TxLoopObject *)self;
+    Py_ssize_t max = 1024;
+    if (!PyArg_ParseTuple(args, "|n:drain", &max))
+        return NULL;
+    uint64_t junk;
+    while (read(lp->comp_fd, &junk, sizeof junk) > 0)
+        ;
+    PyObject *out = PyList_New(0);
+    if (out == NULL)
+        return NULL;
+    uint64_t t = atomic_load_explicit(&lp->comp_tail,
+                                      memory_order_relaxed);
+    Py_ssize_t got = 0;
+    while (got < max) {
+        uint64_t h = atomic_load_explicit(&lp->comp_head,
+                                          memory_order_acquire);
+        if (t == h)
+            break;
+        CompSlot *s = &lp->ring[t & (lp->ring_cap - 1)];
+        PyObject *payload;
+        if (s->c_payload != NULL) {
+            payload = PyBytes_FromStringAndSize(s->c_payload,
+                                                (Py_ssize_t)s->c_len);
+            free(s->c_payload);
+            s->c_payload = NULL;
+            if (payload == NULL) {
+                Py_DECREF(out);
+                return NULL;
+            }
+        } else {
+            payload = Py_None;
+            Py_INCREF(payload);
+        }
+        PyObject *tup = Py_BuildValue(
+            "IKidN", (unsigned int)s->c_kind,
+            (unsigned long long)s->c_id, (int)s->c_status,
+            s->c_t_ready, payload);
+        if (tup == NULL) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        atomic_store_explicit(&lp->comp_tail, t + 1,
+                              memory_order_release);
+        t++;
+        got++;
+        int rc = PyList_Append(out, tup);
+        Py_DECREF(tup);
+        if (rc < 0) {
+            Py_DECREF(out);
+            return NULL;
+        }
+    }
+    atomic_store_explicit(&lp->comp_armed, 0, memory_order_release);
+    if (atomic_load_explicit(&lp->comp_head, memory_order_acquire)
+        != t) {
+        /* More arrived while disarming: re-wake ourselves so the
+           next loop tick drains the remainder. */
+        if (atomic_exchange_explicit(&lp->comp_armed, 1,
+                                     memory_order_acq_rel) == 0) {
+            uint64_t one = 1;
+            ssize_t r = write(lp->comp_fd, &one, sizeof one);
+            (void)r;
+        }
+    }
+    ST_INC(lp, drains);
+    return out;
+}
+
+static const char *const tx_seam_names[SEAM_N] = {
+    "connector", "dns_udp", "dns_tcp",
+};
+static const char *const tx_field_names[WF_N] = {
+    "events", "connects", "errors", "closes", "reads", "writes",
+    "bytes_in", "bytes_out",
+};
+
+static PyObject *
+txloop_counters(PyObject *self, PyObject *noarg)
+{
+    (void)noarg;
+    TxLoopObject *lp = (TxLoopObject *)self;
+    PyObject *out = PyDict_New();
+    if (out == NULL)
+        return NULL;
+    for (int s = 0; s < SEAM_N; s++) {
+        PyObject *d = PyDict_New();
+        if (d == NULL)
+            goto fail;
+        for (int f = 0; f < WF_N; f++) {
+            uint64_t v = atomic_load_explicit(&lp->wire[s][f],
+                                              memory_order_relaxed);
+            PyObject *num = PyLong_FromUnsignedLongLong(v);
+            if (num == NULL
+                || PyDict_SetItemString(d, tx_field_names[f],
+                                        num) < 0) {
+                Py_XDECREF(num);
+                Py_DECREF(d);
+                goto fail;
+            }
+            Py_DECREF(num);
+        }
+        if (PyDict_SetItemString(out, tx_seam_names[s], d) < 0) {
+            Py_DECREF(d);
+            goto fail;
+        }
+        Py_DECREF(d);
+    }
+    return out;
+fail:
+    Py_DECREF(out);
+    return NULL;
+}
+
+static PyObject *
+txloop_stats(PyObject *self, PyObject *noarg)
+{
+    (void)noarg;
+    TxLoopObject *lp = (TxLoopObject *)self;
+#define LD(f) (unsigned long long)atomic_load_explicit( \
+        &lp->st_##f, memory_order_relaxed)
+    return Py_BuildValue(
+        "{s:s,s:I,s:K,s:K,s:K,s:K,s:K,s:K,s:K}",
+        "backend", lp->backend == BK_URING ? "io_uring" : "epoll",
+        "ring_cap", (unsigned int)lp->ring_cap,
+        "wakeups", LD(wakeups),
+        "ring_stalls", LD(ring_stalls),
+        "inline_writes", LD(inline_writes),
+        "buffered_writes", LD(buffered_writes),
+        "drains", LD(drains),
+        "comp_highwater", LD(comp_highwater),
+        "polls", LD(polls));
+#undef LD
+}
+
+static PyObject *
+txloop_backend(PyObject *self, PyObject *noarg)
+{
+    (void)noarg;
+    TxLoopObject *lp = (TxLoopObject *)self;
+    return PyUnicode_FromString(
+        lp->backend == BK_URING ? "io_uring" : "epoll");
+}
+
+static PyObject *
+txloop_fileno(PyObject *self, PyObject *noarg)
+{
+    (void)noarg;
+    return PyLong_FromLong(((TxLoopObject *)self)->comp_fd);
+}
+
+static void
+txloop_teardown(TxLoopObject *lp)
+{
+    if (lp->thread_started && !lp->shut_down) {
+        pthread_mutex_lock(&lp->mu);
+        lp->stopping = 1;
+        pthread_mutex_unlock(&lp->mu);
+        tx_submit(lp, SM_STOP, NULL);
+        Py_BEGIN_ALLOW_THREADS
+        pthread_join(lp->thread, NULL);
+        Py_END_ALLOW_THREADS
+        lp->thread_started = 0;
+    }
+    if (lp->shut_down)
+        return;
+    lp->shut_down = 1;
+    /* The C thread is gone: free everything it owned. */
+    SubMsg *m = lp->sub_head;
+    lp->sub_head = lp->sub_tail = NULL;
+    while (m != NULL) {
+        SubMsg *next = m->next;
+        switch (m->kind) {
+        case SM_READ: {
+            /* A parked read is referenced BOTH by its queued SM_READ
+               message and by conn->pending_read; drop the conn's
+               reference so the per-conn teardown below doesn't free
+               it a second time. */
+            TxOp *op = m->obj;
+            if (op->conn != NULL && op->conn->pending_read == op)
+                op->conn->pending_read = NULL;
+            op_free(op);
+            break;
+        }
+        case SM_CONNECT:
+        case SM_DNS:
+        case SM_TIMER:
+            op_free(m->obj);
+            break;
+        default:
+            break;
+        }
+        free(m);
+        m = next;
+    }
+    for (uint32_t i = 0; i < lp->heap_len; i++) {
+        TxOp *op = lp->heap[i];
+        /* conn-attached ops are freed via their conns below */
+        if (op->kind == OP_DNS_UDP || op->kind == OP_DNS_TCP) {
+            if (op->fd >= 0)
+                close(op->fd);
+            op_free(op);
+        } else if (op->kind == OP_TIMER) {
+            op_free(op);
+        }
+    }
+    lp->heap_len = 0;
+    for (int b = 0; b < CB_CONN_BUCKETS; b++) {
+        TxConn *c = lp->conn_tab[b];
+        lp->conn_tab[b] = NULL;
+        while (c != NULL) {
+            TxConn *next = c->next;
+            if (c->fd >= 0)
+                close(c->fd);
+            if (c->pending_read != NULL)
+                op_free(c->pending_read);
+            if (c->connect_op != NULL)
+                op_free(c->connect_op);
+            conn_free(c);
+            c = next;
+        }
+    }
+    if (lp->ring != NULL) {
+        for (uint64_t i = 0; i < lp->ring_cap; i++)
+            free(lp->ring[i].c_payload);
+        free(lp->ring);
+        lp->ring = NULL;
+    }
+    free(lp->heap);
+    lp->heap = NULL;
+    for (uint32_t i = 0; i < lp->regs_cap; i++)
+        free(lp->regs[i]);
+    lp->regs_cap = 0;
+    free(lp->regs);
+    lp->regs = NULL;
+    free(lp->reg_free);
+    lp->reg_free = NULL;
+#ifdef CUEBALL_HAVE_IO_URING
+    if (lp->ur_ok) {
+        ur_close(&lp->ur);
+        lp->ur_ok = 0;
+    }
+#endif
+    if (lp->ep_fd >= 0) {
+        close(lp->ep_fd);
+        lp->ep_fd = -1;
+    }
+    if (lp->sub_fd >= 0) {
+        close(lp->sub_fd);
+        lp->sub_fd = -1;
+    }
+    if (lp->comp_fd >= 0) {
+        close(lp->comp_fd);
+        lp->comp_fd = -1;
+    }
+}
+
+static PyObject *
+txloop_shutdown(PyObject *self, PyObject *noarg)
+{
+    (void)noarg;
+    txloop_teardown((TxLoopObject *)self);
+    Py_RETURN_NONE;
+}
+
+static void
+txloop_dealloc(PyObject *self)
+{
+    TxLoopObject *lp = (TxLoopObject *)self;
+    txloop_teardown(lp);
+    pthread_mutex_destroy(&lp->mu);
+    Py_TYPE(self)->tp_free(self);
+}
+
+static PyMethodDef txloop_methods[] = {
+    {"connect", txloop_connect, METH_VARARGS,
+     "connect(host, port, timeout_ms=0) -> conn_id"},
+    {"write", txloop_write, METH_VARARGS,
+     "write(conn_id, data) -> bytes sent inline"},
+    {"read", txloop_read, METH_VARARGS,
+     "read(conn_id, n, timeout_ms=0) -> bytes | op_id"},
+    {"read_available", txloop_read_available, METH_VARARGS,
+     "read_available(conn_id) -> buffered bytes"},
+    {"close_conn", txloop_close_conn, METH_VARARGS,
+     "close_conn(conn_id)"},
+    {"dns_udp", txloop_dns_udp, METH_VARARGS,
+     "dns_udp(host, port, payload, timeout_ms=0) -> op_id"},
+    {"dns_tcp", txloop_dns_tcp, METH_VARARGS,
+     "dns_tcp(host, port, payload, timeout_ms=0) -> op_id"},
+    {"timer", txloop_timer, METH_VARARGS,
+     "timer(delay_ms) -> op_id"},
+    {"drain", txloop_drain, METH_VARARGS,
+     "drain(max=1024) -> [(kind, id, status, t_ready, payload)]"},
+    {"counters", txloop_counters, METH_NOARGS,
+     "per-seam wire counters"},
+    {"stats", txloop_stats, METH_NOARGS, "data-plane stats"},
+    {"backend", txloop_backend, METH_NOARGS, "'epoll' | 'io_uring'"},
+    {"fileno", txloop_fileno, METH_NOARGS, "completion wake eventfd"},
+    {"shutdown", txloop_shutdown, METH_NOARGS,
+     "stop and join the C thread, free everything"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PyTypeObject TxLoop_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "cueball_tpu._cueball_native.TransportLoop",
+    .tp_basicsize = sizeof(TxLoopObject),
+    .tp_dealloc = txloop_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "Native transport data plane (one per event loop)",
+    .tp_methods = txloop_methods,
+};
+
+/* ------------------------------------------------------------------ */
+/* Module surface                                                     */
+
+static PyObject *
+mod_txloop_new(PyObject *mod, PyObject *args, PyObject *kw)
+{
+    (void)mod;
+    static char *kwlist[] = {"ring_cap", "backend", NULL};
+    Py_ssize_t ring_cap = 1024;
+    const char *backend = "auto";
+    if (!PyArg_ParseTupleAndKeywords(args, kw, "|ns:txloop_new",
+                                     kwlist, &ring_cap, &backend))
+        return NULL;
+    if (ring_cap < 64)
+        ring_cap = 64;
+    uint32_t cap = 64;
+    while (cap < (uint32_t)ring_cap && cap < (1u << 20))
+        cap *= 2;
+    TxLoopObject *lp = PyObject_New(TxLoopObject, &TxLoop_Type);
+    if (lp == NULL)
+        return NULL;
+    memset((char *)lp + offsetof(TxLoopObject, backend), 0,
+           sizeof(TxLoopObject) - offsetof(TxLoopObject, backend));
+    lp->ep_fd = -1;
+    lp->sub_fd = -1;
+    lp->comp_fd = -1;
+    pthread_mutex_init(&lp->mu, NULL);
+    lp->ring_cap = cap;
+    lp->backend = BK_EPOLL;
+    int want_uring = strcmp(backend, "io_uring") == 0;
+    int want_auto = strcmp(backend, "auto") == 0;
+    if (!want_uring && !want_auto && strcmp(backend, "epoll") != 0) {
+        PyErr_Format(PyExc_ValueError, "unknown backend: %s",
+                     backend);
+        goto fail;
+    }
+#ifdef CUEBALL_HAVE_IO_URING
+    if (want_uring || want_auto) {
+        if (ur_init(&lp->ur) == 0) {
+            lp->ur_ok = 1;
+            lp->backend = BK_URING;
+        } else if (want_uring) {
+            PyErr_SetString(PyExc_OSError,
+                            "io_uring unavailable at runtime");
+            goto fail;
+        }
+    }
+#else
+    if (want_uring) {
+        PyErr_SetString(PyExc_OSError,
+                        "io_uring support not compiled in");
+        goto fail;
+    }
+#endif
+    if (lp->backend == BK_EPOLL) {
+        lp->ep_fd = epoll_create1(EPOLL_CLOEXEC);
+        if (lp->ep_fd < 0) {
+            PyErr_SetFromErrno(PyExc_OSError);
+            goto fail;
+        }
+    }
+    lp->sub_fd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+    lp->comp_fd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+    if (lp->sub_fd < 0 || lp->comp_fd < 0) {
+        PyErr_SetFromErrno(PyExc_OSError);
+        goto fail;
+    }
+    lp->ring = calloc(cap, sizeof(CompSlot));
+    if (lp->ring == NULL) {
+        PyErr_NoMemory();
+        goto fail;
+    }
+    /* Register the submission eventfd before the thread starts, so
+       every poller call after this point happens on the C thread. */
+    Reg *sub_reg = reg_alloc(lp, lp->sub_fd, RK_SUB, NULL);
+    if (sub_reg == NULL || poller_set(lp, sub_reg, POLLIN) < 0) {
+        PyErr_SetString(PyExc_OSError,
+                        "failed to register submission eventfd");
+        goto fail;
+    }
+    if (pthread_create(&lp->thread, NULL, tx_thread_main, lp) != 0) {
+        PyErr_SetString(PyExc_OSError,
+                        "failed to start transport thread");
+        goto fail;
+    }
+    lp->thread_started = 1;
+    return (PyObject *)lp;
+fail:
+    txloop_teardown(lp);
+    lp->shut_down = 1;
+    Py_DECREF(lp);
+    return NULL;
+}
+
+static PyObject *
+mod_transport_probe(PyObject *mod, PyObject *noarg)
+{
+    (void)mod;
+    (void)noarg;
+    int built = 0, runtime = 0;
+#ifdef CUEBALL_HAVE_IO_URING
+    built = 1;
+    {
+        struct io_uring_params p;
+        memset(&p, 0, sizeof p);
+        int fd = sys_io_uring_setup(4, &p);
+        if (fd >= 0) {
+            runtime = (p.features & IORING_FEAT_NODROP) != 0;
+            close(fd);
+        }
+    }
+#endif
+    return Py_BuildValue("{s:O,s:O,s:O}",
+                         "epoll", Py_True,
+                         "io_uring_built", built ? Py_True : Py_False,
+                         "io_uring_runtime",
+                         runtime ? Py_True : Py_False);
+}
+
+static PyMethodDef transport_module_methods[] = {
+    {"txloop_new", (PyCFunction)(void (*)(void))mod_txloop_new,
+     METH_VARARGS | METH_KEYWORDS,
+     "txloop_new(ring_cap=1024, backend='auto') -> TransportLoop"},
+    {"transport_probe", mod_transport_probe, METH_NOARGS,
+     "poller backend availability: build-time and runtime"},
+    {NULL, NULL, 0, NULL},
+};
+
+int
+cueball_transport_init(PyObject *m)
+{
+    if (PyType_Ready(&TxLoop_Type) < 0)
+        return -1;
+    if (PyModule_AddFunctions(m, transport_module_methods) < 0)
+        return -1;
+    Py_INCREF(&TxLoop_Type);
+    if (PyModule_AddObject(m, "TransportLoop",
+                           (PyObject *)&TxLoop_Type) < 0) {
+        Py_DECREF(&TxLoop_Type);
+        return -1;
+    }
+    if (PyModule_AddIntConstant(m, "TX_CONNECT", CB_COMP_CONNECT) < 0
+        || PyModule_AddIntConstant(m, "TX_READ", CB_COMP_READ) < 0
+        || PyModule_AddIntConstant(m, "TX_DATA", CB_COMP_DATA) < 0
+        || PyModule_AddIntConstant(m, "TX_CLOSE", CB_COMP_CLOSE) < 0
+        || PyModule_AddIntConstant(m, "TX_ERROR", CB_COMP_ERROR) < 0
+        || PyModule_AddIntConstant(m, "TX_DNS_UDP",
+                                   CB_COMP_DNS_UDP) < 0
+        || PyModule_AddIntConstant(m, "TX_DNS_TCP",
+                                   CB_COMP_DNS_TCP) < 0
+        || PyModule_AddIntConstant(m, "TX_TIMER", CB_COMP_TIMER) < 0)
+        return -1;
+    return 0;
+}

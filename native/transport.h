@@ -1,0 +1,31 @@
+/*
+ * Shared declarations between the two native compilation units:
+ *
+ * - emitter.c (the event/FSM/trace/profiler core) exports the trace
+ *   hook the transport data plane stamps its reserved wire-event
+ *   slots through (trace.WIRE_EVENT_CODES; the slots share the span
+ *   ring but are skipped by trace._drain_native).
+ * - transport.c (the epoll/io_uring data plane) exports one init
+ *   function that registers its type and module functions on the
+ *   already-created _cueball_native module object.
+ */
+
+#ifndef CUEBALL_TRANSPORT_H
+#define CUEBALL_TRANSPORT_H
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+
+/* emitter.c: append one reserved wire-event slot (code 14..18,
+   serial 0, no object) to the trace event ring. No-op while tracing
+   is off (ring unconfigured) — one branch. GIL must be held. */
+void cueball_wire_trace_emit(uint32_t code, double t, double a,
+                             double b);
+
+/* transport.c: add the transport data-plane surface (TransportLoop
+   type, txloop_new, transport_probe) to module `m`. Returns 0 on
+   success, -1 with a Python error set. */
+int cueball_transport_init(PyObject *m);
+
+#endif /* CUEBALL_TRANSPORT_H */

@@ -83,6 +83,26 @@ def test_a001_registry_read_from_debug_module(tmp_path):
         == {('worker.py', 'A001')}
 
 
+def test_a001_native_loop_touch_unlicensed_fires(tmp_path):
+    # The native completion-drain plane is single-loop-owned state;
+    # a helper that marshals onto the owning loop from outside the
+    # licensed native_transport.py module is exactly the bug class
+    # A001 exists for, and must still fire now that the registry
+    # licenses native_transport.py itself.
+    vs = _run(tmp_path, {'helpers.py': (
+        'def kick_native_drain(plane):\n'
+        '    plane.loop.call_soon_threadsafe(plane.drain)\n')})
+    assert [(v.code, v.line) for v in vs] == [('A001', 2)]
+
+
+def test_a001_native_transport_module_licensed_clean(tmp_path):
+    # ...while the same crossing inside native_transport.py (the
+    # close_plane_threadsafe teardown marshal) is licensed.
+    assert _codes(tmp_path, {'native_transport.py': (
+        'def close_plane_threadsafe(loop):\n'
+        '    loop.call_soon_threadsafe(lambda: None)\n')}) == set()
+
+
 def test_a001_registry_matches_runtime_checker():
     # The static default and the runtime checker's registry are the
     # same tuple (debug.py is the single source of truth); a drift

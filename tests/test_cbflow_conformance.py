@@ -114,6 +114,28 @@ async def _drive_debug_signal():
     await asyncio.sleep(0.05)
 
 
+async def _drive_native_plane_teardown():
+    # Shard teardown reaches a worker loop's native transport plane
+    # from the router thread; close_plane_threadsafe marshals the
+    # whole lookup+close onto the owning loop through
+    # native_transport.py's licensed call_soon_threadsafe. The
+    # crossing happens whether or not the extension (or a plane)
+    # exists, so this leg also runs under CUEBALL_NO_NATIVE=1.
+    from cueball_tpu import native_transport as mod_nt
+    loop = asyncio.get_running_loop()
+    if mod_nt.native_available():
+        mod_nt.get_plane(loop)
+    dispatched = []
+    t = threading.Thread(
+        target=lambda: dispatched.append(
+            mod_nt.close_plane_threadsafe(loop)))
+    t.start()
+    t.join()
+    assert dispatched == [True]
+    await asyncio.sleep(0.05)
+    assert mod_nt.peek_plane(loop) is None
+
+
 def _drive_httpx_sync_bridge():
     pytest.importorskip('httpx')
     from cueball_tpu.integrations.httpx import CueballSyncTransport
@@ -134,6 +156,7 @@ def test_every_licensed_marshal_site_exercised():
         run_async(_drive_thread_router(lc), timeout=90)
         run_async(_drive_spawn_router(), timeout=120)
         run_async(_drive_debug_signal(), timeout=30)
+        run_async(_drive_native_plane_teardown(), timeout=30)
         _drive_httpx_sync_bridge()
     assert lc.violations == [], lc.violations
     assert lc.marshals_exercised \

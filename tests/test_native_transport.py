@@ -1,0 +1,668 @@
+"""Unit suite for the native C transport data plane.
+
+Exercises the TransportLoop extension surface directly (submit/drain
+protocol, write specialization, deadlines, counters) and the Python
+control plane on top (NativePlane dispatch, NativeConnection
+contract, the five-seam RealNativeTransport, the runq wheel-timer
+hook, cross-thread teardown). Runs on the epoll backend always and
+again on io_uring when the runtime has it; the whole module
+skips-with-reason when the extension lacks the transport symbols.
+
+This file is part of ``make native-sanitize``: every path here runs
+under ASan+UBSan in that target.
+"""
+
+import asyncio
+import errno
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from cueball_tpu import native_transport as mod_nt
+from cueball_tpu import runq as mod_runq
+from cueball_tpu import transport as mod_transport
+from cueball_tpu import utils as mod_utils
+from cueball_tpu import wiretap as mod_wiretap
+from cueball_tpu.errors import TransportNotAvailableError
+
+from conftest import run_async
+
+if not mod_nt.native_available():
+    pytest.skip('extension not built with transport symbols '
+                '(or CUEBALL_NO_NATIVE=1)', allow_module_level=True)
+
+from cueball_tpu import _cueball_native as _native
+
+PROBE = _native.transport_probe()
+BACKENDS = ['epoll'] + (['io_uring'] if PROBE['io_uring_runtime']
+                        else [])
+
+
+def _drain_until(tx, pred, timeout_s=5.0):
+    """Poll-drain the completion ring until pred(completions-so-far)
+    or timeout; returns every completion seen."""
+    seen = []
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        seen.extend(tx.drain(1024))
+        if pred(seen):
+            return seen
+        time.sleep(0.002)
+    raise AssertionError('timed out; completions so far: %r' % seen)
+
+
+def _read_all(tx, cid, n, timeout_ms):
+    """Exactly-n read through either the fast path (bytes now) or the
+    completion ring (op id)."""
+    got = tx.read(cid, n, timeout_ms)
+    if isinstance(got, bytes):
+        return got
+    op = got
+    comps = _drain_until(
+        tx, lambda s: any(k == _native.TX_READ and i == op
+                          for k, i, *_ in s),
+        timeout_s=timeout_ms / 1000.0 + 5.0)
+    kind, _i, status, _t, payload = [
+        c for c in comps if c[0] == _native.TX_READ
+        and c[1] == op][0]
+    assert status == 0, 'read failed with status %d' % status
+    return payload
+
+
+@pytest.fixture
+def echo_server():
+    """A plain blocking TCP echo server on a loopback port, on its
+    own thread — independent of any asyncio loop so raw TransportLoop
+    tests need no loop at all."""
+    srv = socket.create_server(('127.0.0.1', 0))
+    srv.settimeout(5.0)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def serve():
+        conns = []
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            c.settimeout(5.0)
+            conns.append(c)
+            threading.Thread(target=pump, args=(c,),
+                             daemon=True).start()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def pump(c):
+        try:
+            while not stop.is_set():
+                data = c.recv(65536)
+                if not data:
+                    break
+                c.sendall(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    yield port
+    stop.set()
+    srv.close()
+    t.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Raw TransportLoop
+
+
+def test_transport_probe_shape():
+    assert PROBE['epoll'] is True
+    assert isinstance(PROBE['io_uring_built'], bool)
+    assert isinstance(PROBE['io_uring_runtime'], bool)
+    if PROBE['io_uring_runtime']:
+        assert PROBE['io_uring_built']
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_connect_echo_read_lifecycle(backend, echo_server):
+    tx = _native.txloop_new(ring_cap=64, backend=backend)
+    try:
+        assert tx.backend() == backend
+        cid = tx.connect('127.0.0.1', echo_server)
+        comps = _drain_until(
+            tx, lambda s: any(k == _native.TX_CONNECT for
+                              k, *_ in s))
+        kind, rid, status, t_ready, payload = comps[-1]
+        assert (kind, rid, status) == (_native.TX_CONNECT, cid, 0)
+        assert t_ready > 0
+        # Inline small-write specialization: open socket, empty
+        # write buffer, payload under the inline cap -> sent
+        # synchronously.
+        assert tx.write(cid, b'ping!') == 5
+        assert tx.stats()['inline_writes'] >= 1
+        got = _read_all(tx, cid, 5, 2000.0)
+        assert got == b'ping!'
+        tx.close_conn(cid)
+    finally:
+        tx.shutdown()
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_large_write_is_buffered_and_echoed(backend, echo_server):
+    tx = _native.txloop_new(backend=backend)
+    try:
+        cid = tx.connect('127.0.0.1', echo_server)
+        _drain_until(tx, lambda s: any(k == _native.TX_CONNECT
+                                       for k, *_ in s))
+        blob = bytes(range(256)) * 1024          # 256 KiB > inline cap
+        sent = tx.write(cid, blob)
+        assert 0 <= sent <= len(blob)
+        got = _read_all(tx, cid, len(blob), 10000.0)
+        assert got == blob
+        assert tx.stats()['buffered_writes'] >= 1
+        counters = tx.counters()['connector']
+        assert counters['bytes_out'] == len(blob)
+        assert counters['bytes_in'] >= len(blob)
+    finally:
+        tx.shutdown()
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_connect_refused_posts_error_status(backend):
+    # A closed port on loopback refuses immediately.
+    probe = socket.socket()
+    probe.bind(('127.0.0.1', 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    tx = _native.txloop_new(backend=backend)
+    try:
+        cid = tx.connect('127.0.0.1', dead_port)
+        comps = _drain_until(
+            tx, lambda s: any(k == _native.TX_CONNECT for
+                              k, *_ in s))
+        kind, rid, status, _t, _p = comps[-1]
+        assert rid == cid
+        assert status == -errno.ECONNREFUSED
+        assert tx.counters()['connector']['errors'] == 1
+    finally:
+        tx.shutdown()
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_read_deadline_completes_with_etimedout(backend,
+                                                echo_server):
+    tx = _native.txloop_new(backend=backend)
+    try:
+        cid = tx.connect('127.0.0.1', echo_server)
+        _drain_until(tx, lambda s: any(k == _native.TX_CONNECT
+                                       for k, *_ in s))
+        op = tx.read(cid, 1, 30.0)              # nothing will arrive
+        assert not isinstance(op, bytes)
+        comps = _drain_until(
+            tx, lambda s: any(k == _native.TX_READ and i == op
+                              for k, i, *_ in s))
+        status = [c for c in comps if c[1] == op][0][2]
+        assert status == -errno.ETIMEDOUT
+    finally:
+        tx.shutdown()
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_read_submit_races_fast_responder(backend, echo_server):
+    """Regression: a response landing between pending_read publication
+    and the SM_READ dispatch used to complete-and-free the op while
+    its submission message was still queued (use-after-free), and
+    txloop_read returned ``op->id`` read back AFTER submission — by
+    which point the C thread may have freed the op, so Python parked
+    futures under pointer garbage. Hammer that window: write-then-
+    immediately-read against a same-host echo so some responses beat
+    the submission dispatch, and insist every slow-path id completes
+    with the right payload."""
+    tx = _native.txloop_new(ring_cap=256, backend=backend)
+    try:
+        cids = [tx.connect('127.0.0.1', echo_server)
+                for _ in range(8)]
+        _drain_until(
+            tx, lambda s: sum(1 for k, *_ in s
+                              if k == _native.TX_CONNECT)
+            >= len(cids))
+        payload = bytes(range(64))
+        for _round in range(100):
+            for cid in cids:
+                tx.write(cid, payload)
+                got = _read_all(tx, cid, len(payload), 5000.0)
+                assert got == payload
+    finally:
+        tx.shutdown()
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_reg_table_growth_keeps_live_conns_valid(backend,
+                                                 echo_server):
+    """Regression: the poller registration table used to be a flat
+    realloc'd array while conns held Reg* into it — growing past the
+    initial 64 slots moved the block and every live registration
+    dangled (glibc heap corruption under load). Hold >64 live conns
+    so the table must double mid-flight, then prove every one of
+    them still moves bytes."""
+    tx = _native.txloop_new(ring_cap=512, backend=backend)
+    try:
+        cids = [tx.connect('127.0.0.1', echo_server)
+                for _ in range(80)]
+        _drain_until(
+            tx, lambda s: sum(1 for k, _i, st, *_ in s
+                              if k == _native.TX_CONNECT
+                              and st == 0) >= len(cids),
+            timeout_s=20.0)
+        payload = bytes(range(64))
+        for cid in cids:
+            tx.write(cid, payload)
+        for cid in cids:
+            assert _read_all(tx, cid, len(payload),
+                             10_000.0) == payload
+    finally:
+        tx.shutdown()
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_zero_delay_timer_ids_stay_valid(backend):
+    """Regression companion: a zero-delay timer can fire and be freed
+    before txloop_timer returns, so the returned id must be captured
+    before submission — every id handed back must show up as exactly
+    one TX_TIMER completion, with no strays."""
+    tx = _native.txloop_new(ring_cap=512, backend=backend)
+    try:
+        ids = [tx.timer(0.0) for _ in range(200)]
+        comps = _drain_until(
+            tx, lambda s: sum(1 for k, *_ in s
+                              if k == _native.TX_TIMER) >= len(ids))
+        fired = [i for k, i, *_ in comps if k == _native.TX_TIMER]
+        assert sorted(fired) == sorted(ids)
+    finally:
+        tx.shutdown()
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_timer_fires_near_deadline(backend):
+    tx = _native.txloop_new(backend=backend)
+    try:
+        t0 = time.monotonic()
+        op = tx.timer(30.0)
+        comps = _drain_until(
+            tx, lambda s: any(k == _native.TX_TIMER and i == op
+                              for k, i, *_ in s))
+        elapsed_ms = (time.monotonic() - t0) * 1000.0
+        assert any(c[1] == op and c[2] == 0 for c in comps)
+        assert 25.0 <= elapsed_ms < 2000.0
+    finally:
+        tx.shutdown()
+
+
+def test_non_numeric_host_raises_valueerror():
+    tx = _native.txloop_new()
+    try:
+        with pytest.raises(ValueError):
+            tx.connect('not-an-ip.example', 80)
+    finally:
+        tx.shutdown()
+
+
+def test_shutdown_is_idempotent_and_blocks_submits(echo_server):
+    tx = _native.txloop_new()
+    cid = tx.connect('127.0.0.1', echo_server)
+    assert cid > 0
+    tx.shutdown()
+    tx.shutdown()
+    with pytest.raises(RuntimeError):
+        tx.connect('127.0.0.1', echo_server)
+    with pytest.raises(RuntimeError):
+        tx.timer(1.0)
+
+
+# ---------------------------------------------------------------------------
+# DNS seams on the wire
+
+
+def _fake_dns_reply(payload):
+    # Echo the qid, flip QR, append a fixed blob: enough for the
+    # transport seam (the sans-io DnsQueryCore owns real parsing).
+    return payload[:2] + b'\x80\x00' + b'fake-dns-body'
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_dns_udp_roundtrip_and_qid_filter(backend):
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(('127.0.0.1', 0))
+    sock.settimeout(5.0)
+    port = sock.getsockname()[1]
+
+    def serve():
+        data, addr = sock.recvfrom(4096)
+        # Spoofed qid first: the C plane must drop it and keep
+        # waiting for the matching datagram.
+        wrong = bytes([data[0] ^ 0xFF, data[1]]) + data[2:]
+        sock.sendto(_fake_dns_reply(wrong), addr)
+        sock.sendto(_fake_dns_reply(data), addr)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    tx = _native.txloop_new(backend=backend)
+    try:
+        query = struct.pack('>H', 0xBEEF) + b'\x01\x00rest'
+        op = tx.dns_udp('127.0.0.1', port, query, 5000.0)
+        comps = _drain_until(
+            tx, lambda s: any(i == op for _k, i, *_ in s))
+        kind, _i, status, _t, payload = [
+            c for c in comps if c[1] == op][0]
+        assert kind == _native.TX_DNS_UDP
+        assert status == 0
+        assert payload == _fake_dns_reply(query)
+        row = tx.counters()['dns_udp']
+        assert row['events'] == 1
+        assert row['bytes_out'] == len(query)
+        assert row['reads'] == 1
+    finally:
+        tx.shutdown()
+        sock.close()
+        t.join(timeout=5.0)
+
+
+@pytest.mark.parametrize('backend', BACKENDS)
+def test_dns_tcp_roundtrip_with_length_framing(backend):
+    srv = socket.create_server(('127.0.0.1', 0))
+    srv.settimeout(5.0)
+    port = srv.getsockname()[1]
+
+    def serve():
+        c, _ = srv.accept()
+        c.settimeout(5.0)
+        hdr = c.recv(2)
+        n = struct.unpack('>H', hdr)[0]
+        body = b''
+        while len(body) < n:
+            body += c.recv(n - len(body))
+        reply = _fake_dns_reply(body)
+        c.sendall(struct.pack('>H', len(reply)) + reply)
+        c.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    tx = _native.txloop_new(backend=backend)
+    try:
+        query = struct.pack('>H', 0xCAFE) + b'\x01\x00tcp-q'
+        op = tx.dns_tcp('127.0.0.1', port, query, 5000.0)
+        comps = _drain_until(
+            tx, lambda s: any(i == op for _k, i, *_ in s))
+        kind, _i, status, _t, payload = [
+            c for c in comps if c[1] == op][0]
+        assert kind == _native.TX_DNS_TCP
+        assert status == 0
+        assert payload == _fake_dns_reply(query)
+        row = tx.counters()['dns_tcp']
+        assert row['connects'] == 1
+        assert row['bytes_out'] == len(query) + 2
+    finally:
+        tx.shutdown()
+        srv.close()
+        t.join(timeout=5.0)
+
+
+def test_dns_udp_timeout_status():
+    # A bound-but-silent UDP port: the deadline must fire.
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(('127.0.0.1', 0))
+    port = sock.getsockname()[1]
+    tx = _native.txloop_new()
+    try:
+        op = tx.dns_udp('127.0.0.1', port,
+                        struct.pack('>H', 7) + b'xx', 40.0)
+        comps = _drain_until(
+            tx, lambda s: any(i == op for _k, i, *_ in s))
+        assert [c for c in comps if c[1] == op][0][2] \
+            == -errno.ETIMEDOUT
+    finally:
+        tx.shutdown()
+        sock.close()
+
+
+# ---------------------------------------------------------------------------
+# NativePlane / RealNativeTransport
+
+
+def test_plane_refuses_non_system_clock():
+    clock = mod_utils.get_clock()
+
+    class FakeClock:
+        def now_ms(self):
+            return 0.0
+
+    loop = asyncio.new_event_loop()
+    try:
+        mod_utils.set_clock(FakeClock())
+        with pytest.raises(TransportNotAvailableError) as ei:
+            mod_nt.get_plane(loop)
+        assert ei.value.seam == 'resolve'
+    finally:
+        mod_utils.set_clock(clock)
+        loop.close()
+
+
+def test_connection_contract_roundtrip(echo_server):
+    async def main():
+        t = mod_transport.get_transport('native')
+        conn = t.connector({'address': '127.0.0.1',
+                            'port': echo_server})
+        fut = asyncio.get_running_loop().create_future()
+        conn.on('connect', lambda: fut.set_result(None))
+        conn.on('error', fut.set_exception)
+        await asyncio.wait_for(fut, 5)
+        assert conn.wt_transport == 'native'
+        ready, dispatched = conn.wt_marks
+        assert 0 < ready <= dispatched
+        assert conn.write(b'abc') == 3
+        assert await asyncio.wait_for(
+            conn.read_exactly(3, 5000.0), 5) == b'abc'
+        conn.destroy()
+        assert conn.destroyed
+        conn.destroy()                          # idempotent
+        mod_nt.close_plane(asyncio.get_running_loop())
+
+    run_async(main(), timeout=15)
+
+
+def test_connection_error_emit_on_refused():
+    probe = socket.socket()
+    probe.bind(('127.0.0.1', 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+
+    async def main():
+        t = mod_transport.get_transport('native')
+        conn = t.connector({'address': '127.0.0.1',
+                            'port': dead_port})
+        fut = asyncio.get_running_loop().create_future()
+        conn.on('connect', lambda: fut.set_result('connected?!'))
+        conn.on('error', fut.set_exception)
+        with pytest.raises(ConnectionRefusedError):
+            await asyncio.wait_for(fut, 5)
+        conn.destroy()
+        mod_nt.close_plane(asyncio.get_running_loop())
+
+    run_async(main(), timeout=15)
+
+
+def test_close_emit_on_remote_hangup():
+    """Remote EOF emits 'close' exactly once; a local destroy()
+    suppresses it (TcpStreamConnection contract)."""
+    srv = socket.create_server(('127.0.0.1', 0))
+    srv.settimeout(5.0)
+    port = srv.getsockname()[1]
+
+    def accept_then_hangup():
+        c, _ = srv.accept()
+        c.close()                               # immediate remote FIN
+
+    t = threading.Thread(target=accept_then_hangup, daemon=True)
+    t.start()
+
+    async def main():
+        tr = mod_transport.get_transport('native')
+        conn = tr.connector({'address': '127.0.0.1', 'port': port})
+        connected = asyncio.get_running_loop().create_future()
+        closed = asyncio.Event()
+        conn.on('connect', lambda: connected.set_result(None))
+        conn.on('error', connected.set_exception)
+        conn.on('close', closed.set)
+        await asyncio.wait_for(connected, 5)
+        await asyncio.wait_for(closed.wait(), 5)
+        # After remote close the conn is gone from the plane; destroy
+        # stays idempotent and emits nothing further.
+        conn.destroy()
+        mod_nt.close_plane(asyncio.get_running_loop())
+
+    run_async(main(), timeout=15)
+    srv.close()
+    t.join(timeout=5.0)
+
+
+def test_destroy_suppresses_close_emit(echo_server):
+    async def main():
+        tr = mod_transport.get_transport('native')
+        conn = tr.connector({'address': '127.0.0.1',
+                             'port': echo_server})
+        connected = asyncio.get_running_loop().create_future()
+        closed = asyncio.Event()
+        conn.on('connect', lambda: connected.set_result(None))
+        conn.on('error', connected.set_exception)
+        conn.on('close', closed.set)
+        await asyncio.wait_for(connected, 5)
+        conn.destroy()
+        await asyncio.sleep(0.1)
+        assert not closed.is_set()
+        mod_nt.close_plane(asyncio.get_running_loop())
+
+    run_async(main(), timeout=15)
+
+
+def test_dns_seams_through_transport(echo_server):
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(('127.0.0.1', 0))
+    sock.settimeout(5.0)
+    port = sock.getsockname()[1]
+
+    def serve():
+        data, addr = sock.recvfrom(4096)
+        sock.sendto(_fake_dns_reply(data), addr)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    async def main():
+        tr = mod_transport.get_transport('native')
+        query = struct.pack('>H', 0x1234) + b'q'
+        data = await tr.dns_udp('127.0.0.1', port, query, 5.0)
+        assert data == _fake_dns_reply(query)
+        with pytest.raises(asyncio.TimeoutError):
+            await tr.dns_udp('127.0.0.1', port,
+                             struct.pack('>H', 9) + b'z', 0.05)
+        mod_nt.close_plane(asyncio.get_running_loop())
+
+    run_async(main(), timeout=15)
+    sock.close()
+    t.join(timeout=5.0)
+
+
+def test_wheel_timer_rides_native_plane(echo_server):
+    """With a plane bound to the loop, a wheel bucket's shared timer
+    arms on the C deadline heap (TX_TIMER completion drives
+    _wheel_fire) instead of loop.call_later."""
+    fired = asyncio.Event()
+
+    class Handle:
+        def _ch_wheel_fire(self, token):
+            fired.set()
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        plane = mod_nt.get_plane(loop)
+        before = plane.tx.stats()
+        token = mod_runq.wheel_arm(
+            mod_utils.current_millis() + 20.0, Handle())
+        assert token is not None
+        assert plane.ops, 'bucket timer did not land on the C plane'
+        await asyncio.wait_for(fired.wait(), 5)
+        mod_nt.close_plane(loop)
+
+    run_async(main(), timeout=15)
+
+
+def test_wheel_timer_falls_back_without_plane():
+    fired = asyncio.Event()
+
+    class Handle:
+        def _ch_wheel_fire(self, token):
+            fired.set()
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        assert mod_nt.peek_plane(loop) is None
+        mod_runq.wheel_arm(mod_utils.current_millis() + 10.0,
+                           Handle())
+        await asyncio.wait_for(fired.wait(), 5)
+
+    run_async(main(), timeout=15)
+
+
+def test_close_plane_threadsafe_from_foreign_thread(echo_server):
+    async def main():
+        loop = asyncio.get_running_loop()
+        mod_nt.get_plane(loop)
+        t = threading.Thread(
+            target=mod_nt.close_plane_threadsafe, args=(loop,))
+        t.start()
+        t.join()
+        await asyncio.sleep(0.05)
+        assert mod_nt.peek_plane(loop) is None
+
+    run_async(main(), timeout=15)
+
+
+def test_wiretap_rows_fold_from_c_counters(echo_server):
+    async def main():
+        t = mod_transport.get_transport('native')
+        mod_wiretap.enable_wiretap()
+        try:
+            conn = t.connector({'address': '127.0.0.1',
+                                'port': echo_server})
+            fut = asyncio.get_running_loop().create_future()
+            conn.on('connect', lambda: fut.set_result(None))
+            conn.on('error', fut.set_exception)
+            await asyncio.wait_for(fut, 5)
+            conn.write(b'hello')
+            await asyncio.wait_for(
+                conn.read_exactly(5, 5000.0), 5)
+            conn.destroy()
+            row = mod_wiretap.snapshot()['native']['connector']
+            assert row['events'] == 1
+            assert row['connects'] == 1
+            assert row['errors'] == 0
+            assert row['bytes_out'] == 5
+            assert row['bytes_in'] >= 5
+        finally:
+            mod_wiretap.disable_wiretap()
+            mod_nt.close_plane(asyncio.get_running_loop())
+
+    run_async(main(), timeout=15)

@@ -81,7 +81,9 @@ def _quiet_timers(fsm_owner):
 
 class _Arm:
     """One transport under test: builds the transport, its backend
-    list, and tears down whatever listened."""
+    list, and tears down whatever listened. The 'asyncio' and
+    'native' arms run real loopback listeners; 'fabric' runs netsim
+    virtual backends."""
 
     def __init__(self, name, n_backends=1):
         self.name = name
@@ -90,7 +92,7 @@ class _Arm:
         self.fabric = None
 
     async def start(self):
-        if self.name == 'asyncio':
+        if self.name in ('asyncio', 'native'):
             backends = []
             for _ in range(self.n_backends):
                 server = await asyncio.start_server(
@@ -99,7 +101,7 @@ class _Arm:
                 backends.append({
                     'address': '127.0.0.1',
                     'port': server.sockets[0].getsockname()[1]})
-            return get_transport('asyncio'), backends
+            return get_transport(self.name), backends
         self.fabric = netsim.Fabric()
         return FabricTransport(self.fabric), [
             {'address': '10.0.0.%d' % (i + 1), 'port': 80}
@@ -109,6 +111,9 @@ class _Arm:
         for server in self.servers:
             server.close()
             await server.wait_closed()
+        if self.name == 'native':
+            from cueball_tpu import native_transport as mod_nt
+            mod_nt.close_plane(asyncio.get_running_loop())
 
 
 async def _pool_soak(transport, backends):
@@ -232,8 +237,9 @@ def _run_arm(arm_name, soak, n_backends=1):
     return events, ledgers, wire
 
 
-def _assert_parity(asy, fab):
-    """The gate: byte-identical transition traces, matching ledgers."""
+def _assert_parity(asy, fab, names=('asyncio', 'fabric')):
+    """The gate: byte-identical transition traces, matching ledgers.
+    ``names`` are the wire-ledger transport labels of the two arms."""
     asy_events, asy_ledgers, asy_wire = asy
     fab_events, fab_ledgers, fab_wire = fab
     assert len(asy_events) > 40   # the soak actually drove the FSMs
@@ -252,8 +258,8 @@ def _assert_parity(asy, fab):
         for led in ledgers:
             assert sum(led['wire'].values()) \
                 == led['phases']['socket_wait'], led
-    _assert_wire_parity(asy_wire.get('asyncio', {}),
-                        fab_wire.get('fabric', {}))
+    _assert_wire_parity(asy_wire.get(names[0], {}),
+                        fab_wire.get(names[1], {}))
 
 
 def _assert_wire_parity(asy_seams, fab_seams):
@@ -280,6 +286,52 @@ def test_pool_soak_parity_asyncio_vs_fabric():
 def test_cset_soak_parity_asyncio_vs_fabric():
     _assert_parity(_run_arm('asyncio', _cset_soak, n_backends=2),
                    _run_arm('fabric', _cset_soak, n_backends=2))
+
+
+# ---------------------------------------------------------------------------
+# Native arm: the C data plane must be trace- and ledger-identical to
+# the asyncio transport on the same real-loopback soaks.
+
+def _native_unavailable_reason():
+    from cueball_tpu import native_transport as mod_nt
+    if not mod_nt.native_available():
+        return ('extension not built with transport symbols '
+                '(or CUEBALL_NO_NATIVE=1)')
+    return None
+
+
+needs_native = pytest.mark.skipif(
+    _native_unavailable_reason() is not None,
+    reason=_native_unavailable_reason() or '')
+
+
+@needs_native
+def test_pool_soak_parity_asyncio_vs_native():
+    _assert_parity(_run_arm('asyncio', _pool_soak),
+                   _run_arm('native', _pool_soak),
+                   names=('asyncio', 'native'))
+
+
+@needs_native
+def test_cset_soak_parity_asyncio_vs_native():
+    _assert_parity(_run_arm('asyncio', _cset_soak, n_backends=2),
+                   _run_arm('native', _cset_soak, n_backends=2),
+                   names=('asyncio', 'native'))
+
+
+@needs_native
+def test_pool_soak_wire_parity_fabric_vs_native():
+    """Close the triangle on the wire ledger: the C data plane's
+    per-seam counters must equal the deterministic fabric arm's.
+    Interleaving-sensitive trace equality is pinned against the
+    asyncio arm above (both real-socket, same scheduling regime); the
+    two startup connects can land either side of the first claim
+    dispatch when comparing real sockets against virtual time, so
+    only the order-insensitive counters are compared here."""
+    nat = _run_arm('native', _pool_soak)
+    fab = _run_arm('fabric', _pool_soak)
+    _assert_wire_parity(nat[2].get('native', {}),
+                        fab[2].get('fabric', {}))
 
 
 # ---------------------------------------------------------------------------
@@ -317,9 +369,19 @@ def test_native_transport_every_seam_raises_typed_error():
         assert 'not available' in str(err)
 
 
-def test_get_transport_native_refuses_at_resolution():
-    with pytest.raises(TransportNotAvailableError) as ei:
-        get_transport('native')
-    assert ei.value.seam == 'resolve'
-    assert ei.value.transport == 'native'
-    assert 'register_transport' in str(ei.value)
+def test_get_transport_native_resolution():
+    """With the extension's transport symbols present, resolving
+    'native' upgrades the stub to the real backend; without them the
+    typed resolution refusal stands."""
+    from cueball_tpu import native_transport as mod_nt
+    if mod_nt.native_available():
+        t = get_transport('native')
+        assert type(t).__name__ == 'RealNativeTransport'
+        assert t.name == 'native'
+        assert t.available
+    else:
+        with pytest.raises(TransportNotAvailableError) as ei:
+            get_transport('native')
+        assert ei.value.seam == 'resolve'
+        assert ei.value.transport == 'native'
+        assert 'register_transport' in str(ei.value)

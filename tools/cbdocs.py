@@ -283,6 +283,7 @@ API_MODULES = ('cueball_tpu', 'cueball_tpu.parallel',
                'cueball_tpu.ops', 'cueball_tpu.netsim',
                'cueball_tpu.shard', 'cueball_tpu.profile',
                'cueball_tpu.transport', 'cueball_tpu.wiretap',
+               'cueball_tpu.native_transport',
                'cueball_tpu.integrations.httpx',
                'cueball_tpu.integrations.aiohttp')
 

@@ -113,6 +113,7 @@ _SUPPRESS_RE = re.compile(
 DEFAULT_MARSHAL_MODULES = (
     'debug.py',
     'integrations/httpx.py',
+    'native_transport.py',
     'shard/proc.py',
     'shard/router.py',
     'shard/worker.py',

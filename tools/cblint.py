@@ -408,19 +408,26 @@ _BYTE_FACTORIES = {
     'create_datagram_endpoint', 'create_server',
 }
 _C110_MSG = ('byte-moving call outside the transport seam (only '
-             'transport.py and netsim/ may touch sockets; route '
-             'through a Transport)')
+             'transport.py, native_transport.py and netsim/ may '
+             'touch sockets; route through a Transport)')
+
+# The files licensed to move bytes. transport.py IS the seam;
+# native_transport.py is the Python control plane of the C data path
+# (its create_stream/serve fallbacks and numeric-address resolution
+# are the 'native' backend's byte-movers, accounted to the same
+# wiretap rows); netsim/ is the fabric behind FabricTransport.
+_C110_LICENSED = {'transport.py', 'native_transport.py'}
 
 
 def layering_applies(path: str) -> bool:
-    """C110 is scoped to the cueball_tpu package proper; transport.py
-    IS the seam and netsim/ is the fabric behind FabricTransport."""
+    """C110 is scoped to the cueball_tpu package proper, minus the
+    licensed byte-movers (_C110_LICENSED and netsim/)."""
     parts = Path(path).parts
     if 'cueball_tpu' not in parts:
         return False
     rel = parts[parts.index('cueball_tpu') + 1:]
     return bool(rel) and 'netsim' not in rel[:-1] \
-        and rel[-1] != 'transport.py'
+        and rel[-1] not in _C110_LICENSED
 
 
 class _LayeringVisitor(ast.NodeVisitor):
